@@ -1,0 +1,16 @@
+"""oryx_tpu — a TPU-native lambda-architecture ML framework.
+
+A from-scratch, TPU-first realization of the capabilities of Oryx 2
+(reference: /root/reference, com.cloudera.oryx): batch / speed / serving
+lambda layers for real-time large-scale machine learning, with ALS
+collaborative filtering, k-means clustering, and random decision forest
+apps, plus a pluggable app API.
+
+Where the reference computes on Spark MLlib over Hadoop executors, this
+framework computes with JAX/XLA: batch training runs as sharded kernels
+over a TPU mesh (jax.sharding + jit), and the speed layer's fold-in
+solves and the serving layer's top-N scoring run as XLA-compiled kernels
+with models resident in device HBM.
+"""
+
+__version__ = "0.1.0"

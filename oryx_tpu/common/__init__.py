@@ -1,0 +1,1 @@
+from . import config, hocon, io_utils, lang, rand, stats, text  # noqa: F401

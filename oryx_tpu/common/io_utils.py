@@ -1,0 +1,58 @@
+"""Filesystem and network IO helpers.
+
+Reference: framework/oryx-common/src/main/java/com/cloudera/oryx/common/
+io/IOUtils.java (deleteRecursively, listFiles glob, chooseFreePort :136,
+mkdirs). Paths may carry a ``file:`` scheme (reference uses Hadoop Path
+URIs); gs:// is accepted and treated as a remote store by higher layers.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import glob as _glob
+import os
+import shutil
+import socket
+
+__all__ = [
+    "strip_scheme", "delete_recursively", "list_files", "mkdirs",
+    "choose_free_port",
+]
+
+
+def strip_scheme(path: str) -> str:
+    """``file:/tmp/x`` or ``file:///tmp/x`` -> ``/tmp/x``; other schemes kept."""
+    if path.startswith("file://"):
+        rest = path[len("file://"):]
+        return rest if rest.startswith("/") else "/" + rest
+    if path.startswith("file:"):
+        return path[len("file:"):]
+    return path
+
+
+def delete_recursively(path: str) -> None:
+    path = strip_scheme(path)
+    if os.path.isdir(path):
+        shutil.rmtree(path, ignore_errors=True)
+    elif os.path.exists(path):
+        with contextlib.suppress(FileNotFoundError):
+            os.remove(path)
+
+
+def list_files(dir_path: str, pattern: str = "*") -> list[str]:
+    """Sorted glob under a directory (reference: IOUtils.listFiles)."""
+    return sorted(_glob.glob(os.path.join(strip_scheme(dir_path), pattern)))
+
+
+def mkdirs(path: str) -> str:
+    path = strip_scheme(path)
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
+def choose_free_port() -> int:
+    """An OS-assigned free TCP port (reference: IOUtils.chooseFreePort :136)."""
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]

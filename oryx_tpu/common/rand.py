@@ -1,0 +1,64 @@
+"""RandomManager — deterministic-when-testing RNG handout.
+
+Reference: framework/oryx-common/src/main/java/com/cloudera/oryx/common/
+random/RandomManager.java:35-52 (`random()`, `useTestSeed()` forcing a fixed
+seed for all handed-out generators, retroactively re-seeding ones already
+handed out).
+
+TPU-native twist: in addition to numpy Generators for host-side code, this
+manager hands out `jax.random` keys so that device-side sampling is
+reproducible under the same test-seed switch.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+
+import numpy as np
+
+__all__ = ["RandomManager"]
+
+_TEST_SEED = 1234567890123456789 & 0xFFFFFFFF
+
+
+class RandomManager:
+    _lock = threading.Lock()
+    _use_test_seed = False
+    # bounded strong refs: only needed so use_test_seed() can retroactively
+    # re-seed generators already handed out, as the reference does
+    _instances: "collections.deque[np.random.Generator]" = collections.deque(maxlen=1024)
+
+    @classmethod
+    def random(cls) -> np.random.Generator:
+        """A new numpy Generator; seeded deterministically in test mode."""
+        with cls._lock:
+            if cls._use_test_seed:
+                gen = np.random.Generator(np.random.PCG64(_TEST_SEED))
+            else:
+                gen = np.random.Generator(np.random.PCG64())
+            cls._instances.append(gen)
+            return gen
+
+    @classmethod
+    def random_seed(cls) -> int:
+        """A seed value for APIs that take ints (jax.random.key et al.)."""
+        with cls._lock:
+            if cls._use_test_seed:
+                return _TEST_SEED
+            return int(np.random.SeedSequence().entropy) & 0x7FFFFFFFFFFFFFFF
+
+    @classmethod
+    def jax_key(cls):
+        import jax
+
+        return jax.random.key(cls.random_seed())
+
+    @classmethod
+    def use_test_seed(cls) -> None:
+        """Switch to fixed-seed mode and retroactively reset generators
+        already handed out (reference: RandomManager.java:86-...)."""
+        with cls._lock:
+            cls._use_test_seed = True
+            for gen in list(cls._instances):
+                gen.bit_generator.state = np.random.PCG64(_TEST_SEED).state

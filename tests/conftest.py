@@ -1,0 +1,24 @@
+"""Test configuration: force JAX onto a virtual 8-device CPU platform so
+sharding/mesh tests run anywhere, and make all randomness deterministic
+(reference test strategy: OryxTest.java:38 + RandomManager.useTestSeed)."""
+
+import os
+
+# Must be set before jax is imported anywhere.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import pytest  # noqa: E402
+
+from oryx_tpu.common.rand import RandomManager  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _test_seed():
+    RandomManager.use_test_seed()
+    yield
