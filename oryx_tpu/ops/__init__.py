@@ -1,0 +1,1 @@
+from . import als_fold_in, solver, vectors  # noqa: F401

@@ -1,0 +1,59 @@
+"""Vector math kernels.
+
+Reference: framework/oryx-common/src/main/java/com/cloudera/oryx/common/
+math/VectorMath.java (dot, norm, cosineSimilarity, transposeTimesSelf :95
+via BLAS dspr, randomVectorF).
+
+TPU-native notes: ``transposeTimesSelf`` on the reference walks a hash map
+of vectors accumulating a packed rank-1 update per row; here the factor
+block is a dense device array and V^T V is a single MXU matmul.  All
+kernels are jit-compiled and accept batched inputs.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.rand import RandomManager
+
+__all__ = [
+    "dot", "norm", "cosine_similarity", "transpose_times_self",
+    "random_vector_f",
+]
+
+
+@jax.jit
+def dot(x: jax.Array, y: jax.Array) -> jax.Array:
+    return jnp.dot(x, y)
+
+
+@jax.jit
+def norm(x: jax.Array) -> jax.Array:
+    return jnp.linalg.norm(x)
+
+
+@jax.jit
+def cosine_similarity(x: jax.Array, y: jax.Array, norm_x_y: jax.Array | None = None):
+    """Cosine similarity; caller may pass precomputed ||x||*||y||
+    (reference: VectorMath.cosineSimilarity with normXY argument)."""
+    d = jnp.dot(x, y)
+    if norm_x_y is None:
+        norm_x_y = jnp.linalg.norm(x) * jnp.linalg.norm(y)
+    return d / norm_x_y
+
+
+@jax.jit
+def transpose_times_self(v: jax.Array) -> jax.Array:
+    """V^T @ V for a (n, k) block of row vectors, accumulated in f32
+    (reference: VectorMath.transposeTimesSelf — packed dspr per row;
+    here one MXU matmul)."""
+    return jnp.matmul(v.T, v, preferred_element_type=jnp.float32)
+
+
+def random_vector_f(features: int, rng: np.random.Generator | None = None) -> np.ndarray:
+    """Random standard-normal float32 vector
+    (reference: VectorMath.randomVectorF)."""
+    rng = rng or RandomManager.random()
+    return rng.standard_normal(features).astype(np.float32)

@@ -4,14 +4,22 @@ sharding/mesh tests run anywhere, and make all randomness deterministic
 
 import os
 
-# Must be set before jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# XLA_FLAGS must be in the env before the CPU backend initializes.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# The environment may have imported jax at interpreter startup (site
+# customization registering a real accelerator plugin), in which case
+# jax captured JAX_PLATFORMS before we could set it. config.update wins
+# regardless of import order; tests must never touch real hardware.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,177 @@
+"""Tier-1 math kernel tests (reference analogs: VectorMathTest,
+LinearSystemSolverTest, ALSUtilsTest)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from oryx_tpu.ops import als_fold_in, solver, vectors
+
+
+# -- vectors ----------------------------------------------------------------
+
+def test_dot_norm_cosine():
+    x = np.array([1.0, 2.0, 3.0], dtype=np.float32)
+    y = np.array([4.0, -5.0, 6.0], dtype=np.float32)
+    assert float(vectors.dot(x, y)) == pytest.approx(12.0)
+    assert float(vectors.norm(x)) == pytest.approx(math.sqrt(14.0))
+    expected = 12.0 / (math.sqrt(14.0) * math.sqrt(77.0))
+    assert float(vectors.cosine_similarity(x, y)) == pytest.approx(expected, rel=1e-6)
+
+
+def test_transpose_times_self():
+    v = np.array([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]], dtype=np.float32)
+    expected = v.T @ v
+    np.testing.assert_allclose(np.asarray(vectors.transpose_times_self(v)),
+                               expected, rtol=1e-6)
+
+
+def test_random_vector_f_deterministic():
+    a = vectors.random_vector_f(8)
+    b = vectors.random_vector_f(8)
+    np.testing.assert_array_equal(a, b)  # test seed active
+    assert a.dtype == np.float32
+
+
+# -- solver -----------------------------------------------------------------
+
+def test_solver_solves_spd_system():
+    rng = np.random.default_rng(42)
+    m = rng.standard_normal((50, 8))
+    a = m.T @ m + 0.1 * np.eye(8)
+    s = solver.get_solver(a)
+    b = rng.standard_normal(8)
+    x = s.solve(b)
+    np.testing.assert_allclose(a @ x, b, atol=1e-3)
+
+
+def test_solver_batch_matches_loop():
+    rng = np.random.default_rng(0)
+    m = rng.standard_normal((40, 6))
+    a = m.T @ m + 0.5 * np.eye(6)
+    s = solver.get_solver(a)
+    bs = rng.standard_normal((10, 6)).astype(np.float32)
+    batch = s.solve(bs)
+    for i in range(10):
+        np.testing.assert_allclose(batch[i], s.solve(bs[i]), rtol=1e-5, atol=1e-5)
+
+
+def test_solver_rejects_singular():
+    a = np.ones((4, 4))  # rank 1
+    with pytest.raises(solver.SingularMatrixSolverException) as ei:
+        solver.get_solver(a)
+    assert ei.value.apparent_rank == 1
+
+
+def test_packed_round_trip():
+    # packed lower-triangular column-major for [[4,1,0],[1,5,2],[0,2,6]]
+    packed = np.array([4.0, 1.0, 0.0, 5.0, 2.0, 6.0])
+    full = solver.unpack_packed(packed)
+    expected = np.array([[4.0, 1.0, 0.0], [1.0, 5.0, 2.0], [0.0, 2.0, 6.0]])
+    np.testing.assert_array_equal(full, expected)
+    s = solver.get_solver(packed)
+    b = np.array([1.0, 2.0, 3.0])
+    np.testing.assert_allclose(expected @ s.solve(b), b, atol=1e-4)
+
+
+# -- fold-in ----------------------------------------------------------------
+
+def _target_qui_scalar(implicit, value, current):
+    """Straight transcription of the documented ALSUtils.computeTargetQui
+    contract, used as an independent oracle."""
+    if not implicit:
+        return value
+    if value > 0.0 and current < 1.0:
+        return current + (value / (1.0 + value)) * (1.0 - max(0.0, current))
+    if value < 0.0 and current > 0.0:
+        return current + (value / (value - 1.0)) * (-min(1.0, current))
+    return float("nan")
+
+
+@pytest.mark.parametrize("implicit", [True, False])
+@pytest.mark.parametrize("value,current", [
+    (1.0, 0.3), (2.5, -0.2), (0.5, 1.5), (-1.0, 0.7), (-0.5, -0.1), (0.0, 0.5),
+])
+def test_compute_target_qui_matches_oracle(implicit, value, current):
+    got = float(als_fold_in.compute_target_qui(implicit, value, current))
+    want = _target_qui_scalar(implicit, value, current)
+    if math.isnan(want):
+        assert math.isnan(got)
+    else:
+        assert got == pytest.approx(want, rel=1e-5)
+
+
+def _setup_solver(k=5, seed=7):
+    rng = np.random.default_rng(seed)
+    y = rng.standard_normal((30, k)).astype(np.float32)
+    yty = y.T @ y
+    return solver.get_solver(yty), yty, rng
+
+
+def test_single_fold_in_moves_qui_toward_target():
+    s, yty, rng = _setup_solver()
+    xu = rng.standard_normal(5).astype(np.float32) * 0.1
+    yi = rng.standard_normal(5).astype(np.float32)
+    qui = float(xu @ yi)
+    new_xu = als_fold_in.compute_updated_xu(s, 1.0, xu, yi, implicit=True)
+    assert new_xu is not None
+    target = _target_qui_scalar(True, 1.0, qui)
+    # after the update, Xu . Yi should be closer to the target...
+    new_qui = float(new_xu @ yi)
+    assert abs(new_qui - target) < abs(qui - target)
+
+
+def test_fold_in_no_item_vector_returns_none():
+    s, _, _ = _setup_solver()
+    assert als_fold_in.compute_updated_xu(s, 1.0, np.zeros(5, np.float32),
+                                          None, True) is None
+
+
+def test_fold_in_no_change_when_target_nan():
+    s, _, rng = _setup_solver()
+    # implicit, positive value but current >= 1 -> NaN target -> no update
+    yi = rng.standard_normal(5).astype(np.float32)
+    xu = 2.0 * yi / float(yi @ yi)  # dot = 2.0 >= 1
+    assert als_fold_in.compute_updated_xu(s, 1.0, xu, yi, True) is None
+
+
+def test_fold_in_new_user_uses_half_baseline():
+    s, _, rng = _setup_solver()
+    yi = rng.standard_normal(5).astype(np.float32)
+    new_xu = als_fold_in.compute_updated_xu(s, 3.0, None, yi, implicit=True)
+    assert new_xu is not None
+    # target from current=0.5, Qui=0: dXu solves toward the full target
+    target = _target_qui_scalar(True, 3.0, 0.5)
+    assert not math.isnan(target)
+
+
+def test_fold_in_explicit_sets_value_as_target():
+    s, _, rng = _setup_solver()
+    xu = rng.standard_normal(5).astype(np.float32) * 0.1
+    yi = rng.standard_normal(5).astype(np.float32)
+    new_xu = als_fold_in.compute_updated_xu(s, 4.0, xu, yi, implicit=False)
+    qui = float(xu @ yi)
+    new_qui = float(new_xu @ yi)
+    assert abs(new_qui - 4.0) < abs(qui - 4.0)
+
+
+def test_fold_in_batch_matches_singles():
+    s, _, rng = _setup_solver(k=6, seed=11)
+    n = 20
+    values = rng.standard_normal(n).astype(np.float32) * 2
+    xu = rng.standard_normal((n, 6)).astype(np.float32) * 0.2
+    yi = rng.standard_normal((n, 6)).astype(np.float32)
+    # some events have no existing Xu
+    xu[3] = np.nan
+    xu[7] = np.nan
+    new_xu, valid = als_fold_in.fold_in_batch(s, values, xu, yi, implicit=True)
+    for i in range(n):
+        single = als_fold_in.compute_updated_xu(
+            s, float(values[i]),
+            None if np.isnan(xu[i]).any() else xu[i], yi[i], True)
+        if single is None:
+            assert not valid[i]
+        else:
+            assert valid[i]
+            np.testing.assert_allclose(new_xu[i], single, rtol=1e-4, atol=1e-5)
