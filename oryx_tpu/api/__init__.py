@@ -1,0 +1,5 @@
+from .batch import BatchLayerUpdate  # noqa: F401
+from .serving import (AbstractServingModelManager, HasCSV,  # noqa: F401
+                      OryxServingException, ServingModel, ServingModelManager)
+from .speed import (AbstractSpeedModelManager, SpeedModel,  # noqa: F401
+                    SpeedModelManager)

@@ -1,0 +1,37 @@
+"""Batch layer user contract.
+
+Reference: framework/oryx-api/src/main/java/com/cloudera/oryx/api/batch/
+BatchLayerUpdate.java:38-59.  Where the reference hands the update
+implementation Spark RDDs, this framework hands it plain in-memory
+sequences of (key, message) pairs — the batch layer's data plane is the
+host, and heavy compute is expected to go through JAX device arrays
+built from these sequences.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Sequence
+
+from ..kafka.api import KeyMessage, TopicProducer
+
+__all__ = ["BatchLayerUpdate"]
+
+
+class BatchLayerUpdate(abc.ABC):
+    """Implementations define how a new batch of data updates the model.
+
+    Configured via ``oryx.batch.update-class`` (import path); may expose
+    a constructor accepting the Config.
+    """
+
+    @abc.abstractmethod
+    def run_update(self,
+                   timestamp_ms: int,
+                   new_data: Sequence[KeyMessage],
+                   past_data: Sequence[KeyMessage],
+                   model_dir: str,
+                   model_update_topic: TopicProducer | None) -> None:
+        """Run one generation: combine new and historical data into a new
+        model, written under ``model_dir`` and announced on the update
+        topic."""

@@ -1,0 +1,53 @@
+"""Speed layer user contract.
+
+Reference: framework/oryx-api/src/main/java/com/cloudera/oryx/api/speed/
+SpeedModelManager.java:37-68, SpeedModel.java:23,
+AbstractSpeedModelManager.java:36.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Iterable, Iterator, Sequence
+
+from ..kafka.api import KeyMessage
+
+__all__ = ["SpeedModel", "SpeedModelManager", "AbstractSpeedModelManager"]
+
+
+class SpeedModel(abc.ABC):
+    """In-memory model state of the speed layer."""
+
+    @abc.abstractmethod
+    def get_fraction_loaded(self) -> float:
+        """Approximate fraction of the model loaded so far (readiness gate)."""
+
+
+class SpeedModelManager(abc.ABC):
+    """Consumes models/updates from the update topic and produces deltas
+    from new input.  Configured via ``oryx.speed.model-manager-class``."""
+
+    @abc.abstractmethod
+    def consume(self, updates: Iterator[KeyMessage]) -> None:
+        """Read model + update messages until the stream ends; maintain
+        the in-memory speed model."""
+
+    @abc.abstractmethod
+    def build_updates(self, new_data: Sequence[KeyMessage]) -> Iterable[str]:
+        """Derive model deltas from one micro-batch of input; each
+        returned string is sent with key "UP"."""
+
+    def close(self) -> None:
+        pass
+
+
+class AbstractSpeedModelManager(SpeedModelManager):
+    """Adapts the stream contract to a per-message callback
+    (reference: AbstractSpeedModelManager.java:36)."""
+
+    def consume(self, updates: Iterator[KeyMessage]) -> None:
+        for km in updates:
+            self.consume_key_message(km.key, km.message)
+
+    @abc.abstractmethod
+    def consume_key_message(self, key: str | None, message: str) -> None: ...

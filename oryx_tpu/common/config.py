@@ -47,12 +47,7 @@ class Config:
     # -- raw access ---------------------------------------------------------
 
     def get(self, path: str) -> Any:
-        cur: Any = self._root
-        for part in path.split("."):
-            if not isinstance(cur, dict) or part not in cur:
-                raise KeyError(path)
-            cur = cur[part]
-        return cur
+        return hocon.lookup(self._root, path)
 
     def has_path(self, path: str) -> bool:
         try:

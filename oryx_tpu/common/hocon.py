@@ -263,13 +263,17 @@ def merge(base: dict, overlay: dict) -> dict:
     return out
 
 
-def _lookup(root: dict, path: str) -> Any:
+def lookup(root: dict, path: str) -> Any:
+    """Dotted-path lookup into a nested dict; KeyError on a missing path."""
     cur: Any = root
     for part in path.split("."):
         if not isinstance(cur, dict) or part not in cur:
             raise KeyError(path)
         cur = cur[part]
     return cur
+
+
+_lookup = lookup  # internal alias
 
 
 def resolve(root: dict) -> dict:

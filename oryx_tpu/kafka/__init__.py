@@ -1,0 +1,3 @@
+from .api import KeyMessage, TopicProducer  # noqa: F401
+from .inproc import InProcBroker, get_broker  # noqa: F401
+from . import utils  # noqa: F401

@@ -1,0 +1,40 @@
+"""Messaging contracts.
+
+Reference: framework/oryx-api/src/main/java/com/cloudera/oryx/api/
+KeyMessage.java:28 (serializable key/message pair), TopicProducer.java:29
+(send/getUpdateBroker/getTopic), and the update-topic key protocol used
+throughout: "MODEL" (inline PMML), "MODEL-REF" (storage path), "UP"
+(app-defined JSON delta) — see MLUpdate.java:215-237 and
+ALSSpeedModelManager.java:223-231.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, NamedTuple, Protocol, runtime_checkable
+
+__all__ = ["KeyMessage", "TopicProducer", "KEY_MODEL", "KEY_MODEL_REF", "KEY_UP"]
+
+# Update-topic key protocol (wire contract)
+KEY_MODEL = "MODEL"
+KEY_MODEL_REF = "MODEL-REF"
+KEY_UP = "UP"
+
+
+class KeyMessage(NamedTuple):
+    """A (key, message) pair from a topic."""
+
+    key: str | None
+    message: str
+
+
+@runtime_checkable
+class TopicProducer(Protocol):
+    """Wraps access to a message topic to write to."""
+
+    def send(self, key: str | None, message: str) -> None: ...
+
+    def get_update_broker(self) -> str: ...
+
+    def get_topic(self) -> str: ...
+
+    def close(self) -> None: ...

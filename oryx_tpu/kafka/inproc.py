@@ -1,0 +1,285 @@
+"""In-process message broker with Kafka topic/offset/consumer-group
+semantics.
+
+Plays two roles, mirroring how the reference treats Kafka:
+
+1. The test-infrastructure broker — the reference's tier-3 integration
+   trick runs a real single-node broker in-process (reference:
+   framework/kafka-util/src/test/java/.../LocalKafkaBroker.java:35,
+   LocalZKServer.java:41).  Here the broker IS in-process, so tests and
+   single-host deployments need no external services at all.
+
+2. The durable input/update log — topics are append-only logs with
+   monotonically increasing offsets; consumers resume from committed
+   per-group offsets (reference: consumer-offset storage in ZooKeeper,
+   KafkaUtils.java:134-180) or replay from the beginning
+   (auto.offset.reset=smallest, how serving/speed layers rebuild model
+   state — ModelManagerListener.java:126, SpeedLayer.java:113).
+
+Brokers are addressed by URI: ``memory://<name>`` resolves to a shared
+named broker in this process.  Optionally ``persist_dir``-backed: each
+topic an append-only JSONL file (line-buffered), offsets in a sidecar
+JSON written behind with a short throttle — single-host restart
+durability; a crash can lose only the last unflushed offset commits,
+which at-least-once delivery turns into redelivery, not loss.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Iterator
+
+from ..common.io_utils import mkdirs
+from .api import KeyMessage, TopicProducer
+
+__all__ = ["InProcBroker", "get_broker", "resolve_broker", "InProcTopicProducer"]
+
+_REGISTRY: dict[str, "InProcBroker"] = {}
+_REGISTRY_LOCK = threading.Lock()
+
+# write-behind interval for the offsets sidecar of a persisted broker
+_OFFSET_FLUSH_SEC = 0.1
+
+
+def get_broker(name: str = "default", persist_dir: str | None = None) -> "InProcBroker":
+    """The shared named broker, creating it on first use."""
+    with _REGISTRY_LOCK:
+        broker = _REGISTRY.get(name)
+        if broker is None:
+            broker = InProcBroker(name=name, persist_dir=persist_dir)
+            _REGISTRY[name] = broker
+        return broker
+
+
+def resolve_broker(broker_uri: str) -> "InProcBroker":
+    """Resolve a broker address to an in-process broker.
+
+    ``memory://<name>`` (or bare ``memory://``) names an in-process
+    broker.  A ``host:port`` address would be a real Kafka-protocol
+    broker; that binding is optional and raises a clear error when the
+    client library is absent (this image has none).
+    """
+    if broker_uri.startswith("memory://"):
+        return get_broker(broker_uri[len("memory://"):] or "default")
+    raise RuntimeError(
+        f"Kafka-protocol broker {broker_uri!r} requested but no Kafka client "
+        "library is available in this environment; use a memory:// broker "
+        "or install kafka-python")
+
+
+class _Topic:
+    def __init__(self, name: str, persist_path: str | None):
+        self.name = name
+        self.log: list[tuple[str | None, str]] = []
+        self.cond = threading.Condition()
+        self.persist_path = persist_path
+        self._fh = None
+        if persist_path:
+            if os.path.exists(persist_path):
+                with open(persist_path, encoding="utf-8") as f:
+                    for line in f:
+                        if line.strip():
+                            k, m = json.loads(line)
+                            self.log.append((k, m))
+            # one long-lived line-buffered handle; not one open() per message
+            self._fh = open(persist_path, "a", encoding="utf-8", buffering=1)
+
+    def append(self, key: str | None, message: str) -> int:
+        with self.cond:
+            self.log.append((key, message))
+            offset = len(self.log) - 1
+            if self._fh is not None:
+                self._fh.write(json.dumps([key, message]) + "\n")
+            self.cond.notify_all()
+            return offset
+
+    def latest_offset(self) -> int:
+        with self.cond:
+            return len(self.log)
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+class InProcBroker:
+    """Named in-process broker: topics + per-group committed offsets."""
+
+    def __init__(self, name: str = "default", persist_dir: str | None = None):
+        self.name = name
+        self._persist_dir = mkdirs(persist_dir) if persist_dir else None
+        self._topics: dict[str, _Topic] = {}
+        self._offsets: dict[tuple[str, str], int] = {}  # (group, topic) -> next offset
+        self._lock = threading.Lock()
+        self._offsets_path = (os.path.join(self._persist_dir, "offsets.json")
+                              if self._persist_dir else None)
+        self._offsets_dirty_since: float | None = None
+        if self._offsets_path and os.path.exists(self._offsets_path):
+            with open(self._offsets_path, encoding="utf-8") as f:
+                self._offsets = {tuple(k.split("\x00", 1)): v  # type: ignore[misc]
+                                 for k, v in json.load(f).items()}
+        if self._persist_dir:
+            for fn in os.listdir(self._persist_dir):
+                if fn.endswith(".topic.jsonl"):
+                    t = fn[:-len(".topic.jsonl")]
+                    self._topics[t] = _Topic(t, os.path.join(self._persist_dir, fn))
+
+    # -- topic admin (KafkaUtils parity: …/kafka/util/KafkaUtils.java) ------
+
+    def topic_exists(self, topic: str) -> bool:
+        with self._lock:
+            return topic in self._topics
+
+    def create_topic(self, topic: str, partitions: int = 1) -> None:
+        with self._lock:
+            if topic not in self._topics:
+                path = (os.path.join(self._persist_dir, f"{topic}.topic.jsonl")
+                        if self._persist_dir else None)
+                self._topics[topic] = _Topic(topic, path)
+
+    def delete_topic(self, topic: str) -> None:
+        with self._lock:
+            t = self._topics.pop(topic, None)
+            if t:
+                t.close()
+                if t.persist_path and os.path.exists(t.persist_path):
+                    os.remove(t.persist_path)
+            self._offsets = {k: v for k, v in self._offsets.items()
+                             if k[1] != topic}
+            self._write_offsets_locked()
+
+    def _topic(self, topic: str) -> _Topic:
+        with self._lock:
+            if topic not in self._topics:
+                path = (os.path.join(self._persist_dir, f"{topic}.topic.jsonl")
+                        if self._persist_dir else None)
+                self._topics[topic] = _Topic(topic, path)
+            return self._topics[topic]
+
+    # -- produce / consume --------------------------------------------------
+
+    def send(self, topic: str, key: str | None, message: str) -> int:
+        return self._topic(topic).append(key, message)
+
+    def latest_offset(self, topic: str) -> int:
+        return self._topic(topic).latest_offset()
+
+    def consume(self, topic: str, group: str | None = None,
+                from_beginning: bool = False,
+                poll_timeout_sec: float = 0.1,
+                stop: threading.Event | None = None,
+                max_idle_sec: float | None = None) -> Iterator[KeyMessage]:
+        """Blocking iterator over a topic.
+
+        With a ``group``, starts at the group's committed offset (or per
+        ``from_beginning`` when none) and commits as it yields — the
+        at-least-once resume contract of the reference's manually
+        managed offsets (UpdateOffsetsFn.java:37-64).  Without a group,
+        starts at the latest (or 0 with ``from_beginning``) and never
+        commits.  Ends when ``stop`` is set or ``max_idle_sec`` elapses
+        with no new messages.
+        """
+        t = self._topic(topic)
+        if group is not None:
+            pos = self.get_offset(group, topic)
+            if pos is None:
+                pos = 0 if from_beginning else t.latest_offset()
+        else:
+            pos = 0 if from_beginning else t.latest_offset()
+        idle_since = time.monotonic()
+        while True:
+            with t.cond:
+                while pos >= len(t.log):
+                    if stop is not None and stop.is_set():
+                        return
+                    if (max_idle_sec is not None
+                            and time.monotonic() - idle_since > max_idle_sec):
+                        return
+                    t.cond.wait(poll_timeout_sec)
+                key, message = t.log[pos]
+            pos += 1
+            idle_since = time.monotonic()
+            # Commit AFTER the consumer's processing (the code between
+            # yields) so a failure mid-processing redelivers: at-least-once,
+            # matching the reference's commit-after-batch ordering
+            # (UpdateOffsetsFn.java:37-64).  A graceful break/close
+            # (GeneratorExit) means the message WAS processed — commit;
+            # an exception propagating through the consumer means it
+            # wasn't — don't.
+            try:
+                yield KeyMessage(key, message)
+            except GeneratorExit:
+                if group is not None:
+                    self.set_offset(group, topic, pos)
+                raise
+            if group is not None:
+                self.set_offset(group, topic, pos)
+            if stop is not None and stop.is_set():
+                return
+
+    # -- offsets (ZK offset-store parity) -----------------------------------
+
+    def get_offset(self, group: str, topic: str) -> int | None:
+        with self._lock:
+            return self._offsets.get((group, topic))
+
+    def set_offset(self, group: str, topic: str, offset: int) -> None:
+        with self._lock:
+            self._offsets[(group, topic)] = offset
+            # throttled write-behind: losing the last few commits on crash
+            # only causes redelivery, which at-least-once already allows
+            if self._offsets_path and (self._offsets_dirty_since is None):
+                self._offsets_dirty_since = time.monotonic()
+            if self._offsets_path and (
+                    time.monotonic() - self._offsets_dirty_since
+                    >= _OFFSET_FLUSH_SEC
+                    or offset >= self.latest_offset_unlocked(topic)):
+                self._write_offsets_locked()
+
+    def latest_offset_unlocked(self, topic: str) -> int:
+        t = self._topics.get(topic)
+        return len(t.log) if t else 0
+
+    def _write_offsets_locked(self) -> None:
+        if self._offsets_path:
+            with open(self._offsets_path, "w", encoding="utf-8") as f:
+                json.dump({"\x00".join(k): v for k, v in self._offsets.items()}, f)
+            self._offsets_dirty_since = None
+
+    def flush(self) -> None:
+        with self._lock:
+            self._write_offsets_locked()
+
+    def fill_in_latest_offsets(self, group: str, topics: list[str]) -> None:
+        """For any topic without a committed offset, commit the latest —
+        'start from now' semantics (reference: KafkaUtils.fillInLatestOffsets)."""
+        for topic in topics:
+            if self.get_offset(group, topic) is None:
+                self.set_offset(group, topic, self.latest_offset(topic))
+
+
+class InProcTopicProducer(TopicProducer):
+    """TopicProducer over an in-process broker
+    (reference: TopicProducerImpl.java:32-94 — lazy producer, async for
+    deltas / sync for models; the in-proc append is always synchronous)."""
+
+    def __init__(self, broker_uri: str, topic: str, async_send: bool = False):
+        self._broker_uri = broker_uri
+        self._topic = topic
+        self._broker = resolve_broker(broker_uri)
+
+    def send(self, key: str | None, message: str) -> None:
+        self._broker.send(self._topic, key, message)
+
+    def get_update_broker(self) -> str:
+        return self._broker_uri
+
+    def get_topic(self) -> str:
+        return self._topic
+
+    def close(self) -> None:
+        pass

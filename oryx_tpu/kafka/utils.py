@@ -1,0 +1,57 @@
+"""Topic admin + offset helpers against a broker URI.
+
+Reference: framework/kafka-util/src/main/java/com/cloudera/oryx/kafka/
+util/KafkaUtils.java (maybeCreateTopic :63, topicExists :100,
+deleteTopic :113, getTopicOffsets/getOffsets :134, setOffsets :161,
+fillInLatestOffsets :181).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from .inproc import resolve_broker
+
+_log = logging.getLogger(__name__)
+
+__all__ = [
+    "maybe_create_topic", "topic_exists", "delete_topic",
+    "get_offsets", "set_offsets", "fill_in_latest_offsets",
+]
+
+
+def maybe_create_topic(broker_uri: str, topic: str, partitions: int = 1) -> None:
+    broker = resolve_broker(broker_uri)
+    if broker.topic_exists(topic):
+        _log.info("No need to create topic %s as it already exists", topic)
+    else:
+        _log.info("Creating topic %s with %d partition(s)", topic, partitions)
+        broker.create_topic(topic, partitions)
+
+
+def topic_exists(broker_uri: str, topic: str) -> bool:
+    return resolve_broker(broker_uri).topic_exists(topic)
+
+
+def delete_topic(broker_uri: str, topic: str) -> None:
+    broker = resolve_broker(broker_uri)
+    if broker.topic_exists(topic):
+        _log.info("Deleting topic %s", topic)
+        broker.delete_topic(topic)
+    else:
+        _log.info("No need to delete topic %s as it does not exist", topic)
+
+
+def get_offsets(broker_uri: str, group: str, topics: list[str]) -> dict[str, int | None]:
+    broker = resolve_broker(broker_uri)
+    return {t: broker.get_offset(group, t) for t in topics}
+
+
+def set_offsets(broker_uri: str, group: str, offsets: dict[str, int]) -> None:
+    broker = resolve_broker(broker_uri)
+    for topic, off in offsets.items():
+        broker.set_offset(group, topic, off)
+
+
+def fill_in_latest_offsets(broker_uri: str, group: str, topics: list[str]) -> None:
+    resolve_broker(broker_uri).fill_in_latest_offsets(group, topics)

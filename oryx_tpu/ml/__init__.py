@@ -1,0 +1,2 @@
+from .mlupdate import MLUpdate  # noqa: F401
+from . import params  # noqa: F401

@@ -1,0 +1,204 @@
+"""The batch-ML training loop: hyperparameter search, train, evaluate,
+pick best, publish.
+
+Reference: framework/oryx-ml/src/main/java/com/cloudera/oryx/ml/
+MLUpdate.java:60-382 — runUpdate :161 (cache, combos, parallel build,
+atomic rename, MODEL vs MODEL-REF publish, publishAdditionalModelData
+hook), findBestCandidatePath :254 (NaN-eval handling, eval-disabled
+case, threshold gate), buildAndEval :299, splitTrainTest :346.
+"""
+
+from __future__ import annotations
+
+import abc
+import logging
+import os
+import time
+from typing import Sequence
+from xml.etree.ElementTree import Element
+
+from ..common import pmml as pmml_io
+from ..common.config import Config
+from ..common.io_utils import delete_recursively, mkdirs, strip_scheme
+from ..common.lang import collect_in_parallel
+from ..common.rand import RandomManager
+from ..kafka.api import KEY_MODEL, KEY_MODEL_REF, KeyMessage, TopicProducer
+from . import params as hp
+from ..api.batch import BatchLayerUpdate
+
+_log = logging.getLogger(__name__)
+
+MODEL_FILE_NAME = "model.pmml.xml"
+
+__all__ = ["MLUpdate", "MODEL_FILE_NAME"]
+
+
+class MLUpdate(BatchLayerUpdate, abc.ABC):
+    """Subclasses supply model building and evaluation; this class runs
+    the per-generation loop."""
+
+    def __init__(self, config: Config):
+        self.config = config
+        self.test_fraction = config.get_double("oryx.ml.eval.test-fraction")
+        self.candidates = config.get_int("oryx.ml.eval.candidates")
+        self.eval_parallelism = config.get_int("oryx.ml.eval.parallelism")
+        self.threshold = config.get_optional_double("oryx.ml.eval.threshold")
+        self.max_message_size = config.get_int("oryx.update-topic.message.max-size")
+        if not 0.0 <= self.test_fraction <= 1.0:
+            raise ValueError("test-fraction must be in [0,1]")
+        if self.candidates < 1:
+            raise ValueError("candidates must be positive")
+        if self.test_fraction == 0.0 and self.candidates > 1:
+            _log.info("Building multiple candidates requires test-fraction > 0; "
+                      "building one model")
+            self.candidates = 1
+
+    # -- subclass contract --------------------------------------------------
+
+    @abc.abstractmethod
+    def get_hyper_parameter_values(self) -> list[hp.HyperParamValues]:
+        ...
+
+    @abc.abstractmethod
+    def build_model(self, train_data: Sequence[KeyMessage],
+                    hyper_parameters: list, candidate_path: str) -> Element | None:
+        """Train on ``train_data`` with the given hyperparameters; return a
+        PMML document (side artifacts may be written under
+        ``candidate_path``)."""
+
+    @abc.abstractmethod
+    def evaluate(self, model: Element, candidate_path: str,
+                 test_data: Sequence[KeyMessage],
+                 train_data: Sequence[KeyMessage]) -> float:
+        """Higher is better (negate error metrics)."""
+
+    def can_publish_additional_model_data(self) -> bool:
+        return False
+
+    def publish_additional_model_data(self, model: Element,
+                                      new_data: Sequence[KeyMessage],
+                                      past_data: Sequence[KeyMessage],
+                                      model_path: str,
+                                      model_update_topic: TopicProducer) -> None:
+        pass
+
+    def split_new_data_to_train_test(
+            self, new_data: Sequence[KeyMessage]
+    ) -> tuple[list[KeyMessage], list[KeyMessage]]:
+        """Random split; apps override for e.g. time-based splits
+        (reference: MLUpdate.splitNewDataToTrainTest)."""
+        rng = RandomManager.random()
+        mask = rng.random(len(new_data)) < self.test_fraction
+        train = [km for km, m in zip(new_data, mask) if not m]
+        test = [km for km, m in zip(new_data, mask) if m]
+        return train, test
+
+    # -- the loop -----------------------------------------------------------
+
+    def run_update(self, timestamp_ms: int,
+                   new_data: Sequence[KeyMessage],
+                   past_data: Sequence[KeyMessage],
+                   model_dir: str,
+                   model_update_topic: TopicProducer | None) -> None:
+        new_data = list(new_data or [])
+        past_data = list(past_data or [])
+
+        ranges = self.get_hyper_parameter_values()
+        per_param = hp.choose_values_per_hyperparam(len(ranges), self.candidates)
+        combos = hp.choose_hyper_parameter_combos(ranges, self.candidates, per_param)
+
+        model_dir_local = mkdirs(model_dir)
+        candidates_path = os.path.join(model_dir_local, ".temporary",
+                                       str(int(time.time() * 1000)))
+        mkdirs(candidates_path)
+
+        best_candidate = self._find_best_candidate_path(
+            new_data, past_data, combos, candidates_path)
+
+        final_path = os.path.join(model_dir_local, str(int(time.time() * 1000)))
+        if best_candidate is None:
+            _log.info("Unable to build any model")
+        else:
+            os.replace(best_candidate, final_path)  # atomic publish
+        delete_recursively(os.path.join(model_dir_local, ".temporary"))
+
+        if model_update_topic is None:
+            _log.info("No update topic configured, not publishing models")
+        else:
+            best_model_path = os.path.join(final_path, MODEL_FILE_NAME)
+            if os.path.exists(best_model_path):
+                size = os.path.getsize(best_model_path)
+                needed = self.can_publish_additional_model_data()
+                not_too_large = size <= self.max_message_size
+                best_model = None
+                if needed or not_too_large:
+                    best_model = pmml_io.read(best_model_path)
+                if not_too_large:
+                    model_update_topic.send(KEY_MODEL, pmml_io.to_string(best_model))
+                else:
+                    model_update_topic.send(KEY_MODEL_REF, best_model_path)
+                if needed:
+                    self.publish_additional_model_data(
+                        best_model, new_data, past_data, final_path,
+                        model_update_topic)
+
+    def _find_best_candidate_path(self, new_data, past_data, combos,
+                                  candidates_path: str) -> str | None:
+        results = collect_in_parallel(
+            self.candidates,
+            lambda i: self._build_and_eval(i, combos, new_data, past_data,
+                                           candidates_path),
+            min(self.eval_parallelism, self.candidates))
+
+        best_path, best_eval = None, float("-inf")
+        for path, eval_ in results:
+            if path is None or not os.path.exists(path):
+                continue
+            if eval_ == eval_:  # not NaN
+                if eval_ > best_eval:
+                    _log.info("Best eval / model path is now %s / %s", eval_, path)
+                    best_eval, best_path = eval_, path
+            elif best_path is None and self.test_fraction == 0.0:
+                # eval disabled: keep the one model that was built
+                best_path = path
+        if self.threshold is not None and best_eval < self.threshold:
+            _log.info("Best model had eval %s, below threshold %s; discarding",
+                      best_eval, self.threshold)
+            best_path = None
+        return best_path
+
+    def _build_and_eval(self, i: int, combos, new_data, past_data,
+                        candidates_path: str) -> tuple[str | None, float]:
+        hyper_parameters = combos[i % len(combos)]
+        candidate_path = os.path.join(candidates_path, str(i))
+        _log.info("Building candidate %d with params %s", i, hyper_parameters)
+
+        train, test = self._split_train_test(new_data, past_data)
+        eval_ = float("nan")
+        if not train:
+            _log.info("No train data to build a model")
+            return candidate_path, eval_
+        model = self.build_model(train, hyper_parameters, candidate_path)
+        if model is None:
+            _log.info("Unable to build a model")
+            return candidate_path, eval_
+        mkdirs(candidate_path)
+        model_path = os.path.join(candidate_path, MODEL_FILE_NAME)
+        pmml_io.write(model, model_path)
+        if not test:
+            _log.info("No test data available to evaluate model")
+        else:
+            eval_ = self.evaluate(model, candidate_path, test, train)
+        _log.info("Model eval for params %s: %s (%s)", hyper_parameters, eval_,
+                  candidate_path)
+        return candidate_path, eval_
+
+    def _split_train_test(self, new_data, past_data):
+        if self.test_fraction <= 0.0:
+            return list(new_data) + list(past_data), []
+        if self.test_fraction >= 1.0:
+            return list(past_data), list(new_data)
+        if not new_data:
+            return list(past_data), []
+        new_train, test = self.split_new_data_to_train_test(new_data)
+        return list(new_train) + list(past_data), test

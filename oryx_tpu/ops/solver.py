@@ -112,4 +112,11 @@ def get_solver(a) -> Solver:
             f"{a.shape[0]} x {a.shape[1]} matrix is near-singular "
             f"(threshold {threshold}). Apparent rank: {apparent_rank}")
     chol = jnp.linalg.cholesky(jnp.asarray(a, dtype=jnp.float32))
+    # Cholesky silently yields NaN for indefinite A (symmetric but not
+    # PD can still pass the SVD singularity gate) — reject it here
+    # rather than let NaN propagate into every later solve
+    if bool(jnp.any(jnp.isnan(chol))):
+        rank = int(np.sum(svals > 0.01 * svals[0]))
+        raise SingularMatrixSolverException(
+            rank, f"matrix is not positive definite; apparent rank: {rank}")
     return Solver(chol)

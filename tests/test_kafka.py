@@ -1,0 +1,158 @@
+"""Messaging layer tests (reference analogs: KafkaUtilsTest and the
+LocalKafkaBroker-based produce/consume fixtures)."""
+
+import threading
+import time
+
+import pytest
+
+from oryx_tpu.kafka import InProcBroker, utils
+from oryx_tpu.kafka.api import KEY_MODEL, KEY_UP, KeyMessage
+from oryx_tpu.kafka.inproc import InProcTopicProducer, get_broker, resolve_broker
+
+
+@pytest.fixture
+def broker():
+    b = InProcBroker("test-" + str(time.monotonic_ns()))
+    yield b
+
+
+def test_topic_admin(broker):
+    assert not broker.topic_exists("t")
+    broker.create_topic("t")
+    assert broker.topic_exists("t")
+    broker.delete_topic("t")
+    assert not broker.topic_exists("t")
+
+
+def test_produce_consume_from_beginning(broker):
+    broker.send("t", KEY_MODEL, "<PMML/>")
+    broker.send("t", KEY_UP, '["X","u1",[0.1]]')
+    got = []
+    stop = threading.Event()
+    for km in broker.consume("t", from_beginning=True, stop=stop,
+                             max_idle_sec=0.2):
+        got.append(km)
+        if len(got) == 2:
+            stop.set()
+    assert got == [KeyMessage(KEY_MODEL, "<PMML/>"),
+                   KeyMessage(KEY_UP, '["X","u1",[0.1]]')]
+
+
+def test_consume_latest_skips_history(broker):
+    broker.send("t", None, "old")
+    out = list(broker.consume("t", max_idle_sec=0.1))
+    assert out == []
+
+
+def test_group_offsets_resume(broker):
+    for i in range(5):
+        broker.send("t", None, f"m{i}")
+    first = []
+    for km in broker.consume("t", group="g", from_beginning=True, max_idle_sec=0.1):
+        first.append(km.message)
+        if len(first) == 3:
+            break
+    assert first == ["m0", "m1", "m2"]
+    # a new consumer in the same group resumes where the first stopped
+    rest = [km.message for km in broker.consume("t", group="g", max_idle_sec=0.1)]
+    assert rest == ["m3", "m4"]
+
+
+def test_fill_in_latest_offsets(broker):
+    broker.send("t", None, "a")
+    broker.send("t", None, "b")
+    broker.fill_in_latest_offsets("g", ["t"])
+    assert broker.get_offset("g", "t") == 2
+    out = [km.message for km in broker.consume("t", group="g", max_idle_sec=0.1)]
+    assert out == []  # starts from now
+
+
+def test_blocking_consumer_sees_live_messages(broker):
+    got = []
+    done = threading.Event()
+
+    def consumer():
+        for km in broker.consume("t", from_beginning=True, max_idle_sec=2.0):
+            got.append(km.message)
+            if len(got) == 2:
+                done.set()
+                return
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    time.sleep(0.05)
+    broker.send("t", None, "live1")
+    broker.send("t", None, "live2")
+    assert done.wait(3.0)
+    t.join()
+    assert got == ["live1", "live2"]
+
+
+def test_offset_commits_after_processing(broker):
+    # at-least-once: if the consumer FAILS while processing message N, the
+    # group offset must still point at N so it is redelivered
+    for i in range(3):
+        broker.send("t", None, f"m{i}")
+    it = broker.consume("t", group="g", from_beginning=True, max_idle_sec=0.1)
+    next(it)  # m0 delivered, processing begins...
+    with pytest.raises(RuntimeError):
+        it.throw(RuntimeError("crash mid-processing"))
+    # m0 was never committed -> a restarted consumer sees it again
+    redelivered = [km.message
+                   for km in broker.consume("t", group="g", from_beginning=True,
+                                            max_idle_sec=0.1)]
+    assert redelivered[0] == "m0"
+
+
+def test_delete_topic_clears_persisted_offsets(tmp_path):
+    b1 = InProcBroker("d1", persist_dir=str(tmp_path))
+    b1.send("t", None, "x")
+    b1.set_offset("g", "t", 1)
+    b1.flush()
+    b1.delete_topic("t")
+    b2 = InProcBroker("d2", persist_dir=str(tmp_path))
+    assert b2.get_offset("g", "t") is None
+
+
+def test_persistence_round_trip(tmp_path):
+    b1 = InProcBroker("p1", persist_dir=str(tmp_path))
+    b1.send("t", "k", "v1")
+    b1.send("t", None, "v2")
+    b1.set_offset("g", "t", 1)
+    b1.flush()
+    # a fresh broker over the same dir sees the log and offsets
+    b2 = InProcBroker("p2", persist_dir=str(tmp_path))
+    msgs = [km for km in b2.consume("t", from_beginning=True, max_idle_sec=0.1)]
+    assert [(m.key, m.message) for m in msgs] == [("k", "v1"), (None, "v2")]
+    assert b2.get_offset("g", "t") == 1
+
+
+def test_producer_and_uri_resolution():
+    uri = "memory://uri-test"
+    p = InProcTopicProducer(uri, "topicA")
+    p.send("k", "m")
+    assert p.get_update_broker() == uri
+    assert p.get_topic() == "topicA"
+    b = resolve_broker(uri)
+    assert [km.message for km in b.consume("topicA", from_beginning=True,
+                                           max_idle_sec=0.1)] == ["m"]
+
+
+def test_resolve_rejects_external_broker():
+    with pytest.raises(RuntimeError, match="Kafka"):
+        resolve_broker("localhost:9092")
+
+
+def test_utils_module():
+    uri = "memory://utils-test"
+    utils.maybe_create_topic(uri, "t1")
+    assert utils.topic_exists(uri, "t1")
+    utils.maybe_create_topic(uri, "t1")  # idempotent
+    get_broker("utils-test").send("t1", None, "x")
+    utils.fill_in_latest_offsets(uri, "g", ["t1"])
+    assert utils.get_offsets(uri, "g", ["t1"]) == {"t1": 1}
+    utils.set_offsets(uri, "g", {"t1": 0})
+    assert utils.get_offsets(uri, "g", ["t1"]) == {"t1": 0}
+    utils.delete_topic(uri, "t1")
+    assert not utils.topic_exists(uri, "t1")

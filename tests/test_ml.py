@@ -1,0 +1,199 @@
+"""ML loop tests (reference analogs: HyperParamsTest, SimpleMLUpdateIT,
+ThresholdIT via MockMLUpdate)."""
+
+import os
+from xml.etree.ElementTree import Element
+
+import pytest
+
+from oryx_tpu.common import pmml as pmml_io
+from oryx_tpu.common.config import from_dict
+from oryx_tpu.kafka.api import KEY_MODEL, KeyMessage
+from oryx_tpu.kafka.inproc import InProcTopicProducer, get_broker
+from oryx_tpu.ml import params as hp
+from oryx_tpu.ml.mlupdate import MODEL_FILE_NAME, MLUpdate
+
+
+# -- params -----------------------------------------------------------------
+
+def test_fixed_and_unordered():
+    assert hp.fixed(7).get_trial_values(3) == [7]
+    assert hp.unordered(["a", "b", "c"]).get_trial_values(2) == ["a", "b"]
+
+
+def test_continuous_range_trials():
+    r = hp.range_values(0.0, 1.0)
+    assert r.get_trial_values(1) == [0.5]
+    assert r.get_trial_values(2) == [0.0, 1.0]
+    vals = r.get_trial_values(5)
+    assert vals == pytest.approx([0.0, 0.25, 0.5, 0.75, 1.0])
+
+
+def test_discrete_range_trials():
+    r = hp.range_values(1, 10)
+    assert r.get_trial_values(1) == [5]
+    assert r.get_trial_values(2) == [1, 10]
+    assert r.get_trial_values(4) == [1, 4, 7, 10]
+    # more trials than distinct values -> all values
+    assert hp.range_values(1, 3).get_trial_values(10) == [1, 2, 3]
+
+
+def test_around_trials():
+    assert hp.around(10, 2).get_trial_values(3) == [8, 10, 12]
+    assert hp.around(1.0, 0.5).get_trial_values(3) == pytest.approx([0.5, 1.0, 1.5])
+    assert hp.around(1.0, 0.5).get_trial_values(1) == [1.0]
+
+
+def test_choose_values_per_hyperparam():
+    assert hp.choose_values_per_hyperparam(0, 5) == 0
+    assert hp.choose_values_per_hyperparam(1, 5) == 5
+    assert hp.choose_values_per_hyperparam(2, 5) == 3  # 3^2 = 9 >= 5
+    assert hp.choose_values_per_hyperparam(3, 8) == 2  # 2^3 = 8
+
+
+def test_choose_combos_grid_and_subset():
+    ranges = [hp.unordered([1, 2, 3]), hp.unordered(["x", "y"])]
+    combos = hp.choose_hyper_parameter_combos(ranges, 100, 3)
+    assert len(combos) == 6
+    assert sorted(map(tuple, combos)) == sorted(
+        [(a, b) for b in ["x", "y"] for a in [1, 2, 3]])
+    subset = hp.choose_hyper_parameter_combos(ranges, 2, 3)
+    assert len(subset) == 2
+    # no params -> single empty combo
+    assert hp.choose_hyper_parameter_combos([], 3, 0) == [[]]
+
+
+def test_from_config():
+    cfg = from_dict({
+        "a.fixed-int": 5, "a.fixed-double": 1.5, "a.range-int": [2, 8],
+        "a.range-double": [0.1, 0.9], "a.unordered": ["gini", "entropy"],
+    })
+    assert hp.from_config(cfg, "a.fixed-int").get_trial_values(2) == [5]
+    assert hp.from_config(cfg, "a.fixed-double").get_trial_values(1) == [1.5]
+    assert hp.from_config(cfg, "a.range-int").get_trial_values(2) == [2, 8]
+    assert hp.from_config(cfg, "a.range-double").get_trial_values(2) == [0.1, 0.9]
+    assert hp.from_config(cfg, "a.unordered").get_trial_values(9) == ["gini", "entropy"]
+
+
+def test_from_config_unordered_keeps_native_types():
+    cfg = from_dict({"a.ints": [5, 10, 20], "a.mixed": [1.5, 2.5, 3.5]})
+    assert hp.from_config(cfg, "a.ints").get_trial_values(3) == [5, 10, 20]
+    assert hp.from_config(cfg, "a.mixed").get_trial_values(3) == [1.5, 2.5, 3.5]
+
+
+# -- pmml -------------------------------------------------------------------
+
+def test_pmml_skeleton_and_extensions(tmp_path):
+    doc = pmml_io.build_skeleton_pmml()
+    pmml_io.add_extension(doc, "features", 10)
+    pmml_io.add_extension(doc, "implicit", True)
+    pmml_io.add_extension_content(doc, "XIDs", ["u1", "u 2", "u3"])
+    path = str(tmp_path / "model.pmml.xml")
+    pmml_io.write(doc, path)
+    loaded = pmml_io.read(path)
+    assert pmml_io.get_extension_value(loaded, "features") == "10"
+    assert pmml_io.get_extension_value(loaded, "implicit") == "true"
+    assert pmml_io.get_extension_content(loaded, "XIDs") == ["u1", "u 2", "u3"]
+    assert pmml_io.get_extension_value(loaded, "nope") is None
+    # round-trip through string form (the MODEL message payload)
+    re_read = pmml_io.from_string(pmml_io.to_string(loaded))
+    assert pmml_io.get_extension_value(re_read, "features") == "10"
+
+
+# -- MLUpdate ---------------------------------------------------------------
+
+class MockMLUpdate(MLUpdate):
+    """Records train/test sizes, emits a dummy PMML whose eval is set by
+    the test (reference: MockMLUpdate.java:35)."""
+
+    evals: list[float] = []
+    train_counts: list[int] = []
+    test_counts: list[int] = []
+    _call = 0
+
+    def get_hyper_parameter_values(self):
+        return []
+
+    def build_model(self, train_data, hyper_parameters, candidate_path):
+        MockMLUpdate.train_counts.append(len(train_data))
+        doc = pmml_io.build_skeleton_pmml()
+        pmml_io.add_extension(doc, "mock", "yes")
+        return doc
+
+    def evaluate(self, model, candidate_path, test_data, train_data):
+        MockMLUpdate.test_counts.append(len(test_data))
+        i = MockMLUpdate._call
+        MockMLUpdate._call += 1
+        return MockMLUpdate.evals[i % len(MockMLUpdate.evals)]
+
+
+def _run_update(cfg_overlay, data, tmp_path, topic_name):
+    cfg = from_dict(cfg_overlay)
+    update = MockMLUpdate(cfg)
+    producer = InProcTopicProducer("memory://ml-test", topic_name)
+    model_dir = str(tmp_path / "model")
+    update.run_update(0, data, [], model_dir, producer)
+    broker = get_broker("ml-test")
+    msgs = list(broker.consume(topic_name, from_beginning=True, max_idle_sec=0.1))
+    return model_dir, msgs
+
+
+def _reset_mock(evals):
+    MockMLUpdate.evals = evals
+    MockMLUpdate.train_counts = []
+    MockMLUpdate.test_counts = []
+    MockMLUpdate._call = 0
+
+
+def test_mlupdate_publishes_model(tmp_path):
+    _reset_mock([0.5])
+    data = [KeyMessage(None, f"line{i}") for i in range(100)]
+    model_dir, msgs = _run_update({}, data, tmp_path, "t1")
+    assert len(msgs) == 1
+    assert msgs[0].key == KEY_MODEL
+    doc = pmml_io.from_string(msgs[0].message)
+    assert pmml_io.get_extension_value(doc, "mock") == "yes"
+    # model dir holds one timestamped dir with the model file; temp cleaned
+    entries = os.listdir(model_dir)
+    assert len(entries) == 1 and entries[0].isdigit()
+    assert MODEL_FILE_NAME in os.listdir(os.path.join(model_dir, entries[0]))
+    # ~10% went to test by default
+    assert MockMLUpdate.train_counts[0] + MockMLUpdate.test_counts[0] == 100
+    assert 1 <= MockMLUpdate.test_counts[0] <= 30
+
+
+def test_mlupdate_threshold_rejects_model(tmp_path):
+    _reset_mock([0.1])
+    data = [KeyMessage(None, f"line{i}") for i in range(50)]
+    model_dir, msgs = _run_update({"oryx.ml.eval.threshold": 0.9}, data,
+                                  tmp_path, "t2")
+    assert msgs == []  # model discarded
+    assert os.listdir(model_dir) == []
+
+
+def test_mlupdate_candidates_pick_best(tmp_path):
+    _reset_mock([0.1, 0.9, 0.3])
+    data = [KeyMessage(None, f"line{i}") for i in range(60)]
+    _, msgs = _run_update({"oryx.ml.eval.candidates": 3,
+                           "oryx.ml.eval.parallelism": 1}, data, tmp_path, "t3")
+    assert len(msgs) == 1 and msgs[0].key == KEY_MODEL
+    assert MockMLUpdate._call == 3
+
+
+def test_mlupdate_eval_disabled_keeps_model(tmp_path):
+    _reset_mock([float("nan")])
+    data = [KeyMessage(None, "x")] * 10
+    _, msgs = _run_update({"oryx.ml.eval.test-fraction": 0.0}, data,
+                          tmp_path, "t4")
+    assert len(msgs) == 1  # model kept though never evaluated
+    assert MockMLUpdate.test_counts == []
+
+
+def test_mlupdate_model_ref_when_too_large(tmp_path):
+    _reset_mock([0.5])
+    data = [KeyMessage(None, "x")] * 10
+    _, msgs = _run_update({"oryx.update-topic.message.max-size": 10}, data,
+                          tmp_path, "t5")
+    assert len(msgs) == 1
+    assert msgs[0].key == "MODEL-REF"
+    assert os.path.exists(msgs[0].message)

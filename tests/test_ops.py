@@ -64,6 +64,14 @@ def test_solver_rejects_singular():
     assert ei.value.apparent_rank == 1
 
 
+def test_solver_rejects_indefinite():
+    # symmetric, nonsingular, but not positive definite: Cholesky would
+    # silently produce NaN without the guard
+    a = np.array([[1.0, 0.0], [0.0, -1.0]])
+    with pytest.raises(solver.SingularMatrixSolverException):
+        solver.get_solver(a)
+
+
 def test_packed_round_trip():
     # packed lower-triangular column-major for [[4,1,0],[1,5,2],[0,2,6]]
     packed = np.array([4.0, 1.0, 0.0, 5.0, 2.0, 6.0])
