@@ -1,0 +1,1 @@
+from .rescorer import MultiRescorer, MultiRescorerProvider, Rescorer, RescorerProvider  # noqa: F401

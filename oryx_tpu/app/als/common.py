@@ -1,0 +1,137 @@
+"""ALS input parsing, decay, and aggregation semantics.
+
+Reference: app/oryx-app-mllib/src/main/java/com/cloudera/oryx/app/batch/
+mllib/als/ALSUpdate.java — parsedToRatingRDD :349 (empty strength ==
+delete -> NaN, timestamp ordering), decayRating :383, aggregateScores
+:395-423 (implicit: NaN-propagating sum so a delete wipes the pair;
+explicit: last-wins), knownsRDD :551-577 (timestamp-ordered add/remove
+per user), and app/oryx-app-common/.../fn/MLFunctions.java (PARSE_FN,
+TO_TIMESTAMP_FN, SUM_WITH_NAN).
+
+These are host-side string/dictionary transforms that feed the device
+trainer; the numeric output is a compact COO (user_idx, item_idx, value)
+triple ready for device scatter.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Iterable, NamedTuple, Sequence
+
+import numpy as np
+
+from ...common import text as text_utils
+from ...kafka.api import KeyMessage
+
+__all__ = ["ParsedRatings", "parse_events", "aggregate", "build_known_items",
+           "decay_value"]
+
+MS_PER_DAY = 86_400_000.0
+
+
+class ParsedRatings(NamedTuple):
+    """Aggregated interaction data in index space."""
+
+    user_ids: list[str]           # index -> user ID (sorted)
+    item_ids: list[str]           # index -> item ID (sorted)
+    users: np.ndarray             # (nnz,) int32 user indices
+    items: np.ndarray             # (nnz,) int32 item indices
+    values: np.ndarray            # (nnz,) float32 aggregated strengths
+
+
+def _parse_line(line: str) -> tuple[str, str, float, int]:
+    tokens = text_utils.parse_input_line(line)
+    user, item = tokens[0], tokens[1]
+    # empty strength means 'delete'; propagate as NaN
+    value = float("nan") if tokens[2] == "" else float(tokens[2])
+    ts = int(float(tokens[3])) if len(tokens) > 3 and tokens[3] != "" else 0
+    return user, item, value, ts
+
+
+def decay_value(value: float, timestamp_ms: int, now_ms: int,
+                factor: float) -> float:
+    """Per-day exponential decay (reference: ALSUpdate.decayRating :383)."""
+    if timestamp_ms >= now_ms:
+        return value
+    days = (now_ms - timestamp_ms) / MS_PER_DAY
+    return value * math.pow(factor, days)
+
+
+def parse_events(data: Iterable[KeyMessage | str],
+                 decay_factor: float = 1.0,
+                 decay_zero_threshold: float = 0.0,
+                 now_ms: int | None = None) -> list[tuple[str, str, float, int]]:
+    """Parse, decay, and threshold raw input lines; returns (user, item,
+    value, ts) tuples ordered by timestamp."""
+    now_ms = int(time.time() * 1000) if now_ms is None else now_ms
+    out = []
+    for km in data:
+        line = km.message if isinstance(km, KeyMessage) else km
+        user, item, value, ts = _parse_line(line)
+        if decay_factor < 1.0 and not math.isnan(value):
+            value = decay_value(value, ts, now_ms, decay_factor)
+        if decay_zero_threshold > 0.0 and value <= decay_zero_threshold:
+            # decayed to nothing -> drop (NaN compares False: deletes kept)
+            if not math.isnan(value):
+                continue
+        out.append((user, item, value, ts))
+    out.sort(key=lambda t: t[3])
+    return out
+
+
+def aggregate(events: Sequence[tuple[str, str, float, int]],
+              implicit: bool,
+              log_strength: bool = False,
+              epsilon: float = float("nan")) -> ParsedRatings:
+    """Collapse per-(user,item) events into one strength each.
+
+    Implicit: sum with NaN propagation — any delete wipes the pair, and
+    the pair drops out entirely.  Explicit: last (by timestamp) wins;
+    NaN last value drops the pair.  (reference: aggregateScores :395-423)
+    """
+    agg: dict[tuple[str, str], float] = {}
+    for user, item, value, _ in events:  # events already timestamp-ordered
+        key = (user, item)
+        if implicit:
+            cur = agg.get(key)
+            agg[key] = value if cur is None else cur + value  # NaN propagates
+        else:
+            agg[key] = value
+    pairs = [(k, v) for k, v in agg.items() if not math.isnan(v)]
+
+    if log_strength:
+        # log1p(v/eps) is undefined for v <= -eps; treat as NaN (the
+        # reference's Math.log1p yields NaN rather than raising) and
+        # drop the pair instead of aborting the whole build
+        pairs = [(k, math.log1p(v / epsilon)) if v / epsilon > -1.0
+                 else (k, float("nan")) for k, v in pairs]
+        pairs = [(k, v) for k, v in pairs if not math.isnan(v)]
+
+    user_ids = sorted({u for (u, _), _ in pairs})
+    item_ids = sorted({i for (_, i), _ in pairs})
+    uidx = {u: j for j, u in enumerate(user_ids)}
+    iidx = {i: j for j, i in enumerate(item_ids)}
+    n = len(pairs)
+    users = np.empty(n, dtype=np.int32)
+    items = np.empty(n, dtype=np.int32)
+    values = np.empty(n, dtype=np.float32)
+    for j, ((u, i), v) in enumerate(pairs):
+        users[j] = uidx[u]
+        items[j] = iidx[i]
+        values[j] = v
+    return ParsedRatings(user_ids, item_ids, users, items, values)
+
+
+def build_known_items(events: Sequence[tuple[str, str, float, int]]
+                      ) -> dict[str, set[str]]:
+    """Timestamp-ordered known-items per user: a delete (NaN) removes the
+    item from the set (reference: ALSUpdate.knownsRDD :551-577)."""
+    known: dict[str, set[str]] = {}
+    for user, item, value, _ in events:
+        s = known.setdefault(user, set())
+        if math.isnan(value):
+            s.discard(item)
+        else:
+            s.add(item)
+    return known

@@ -1,0 +1,187 @@
+"""Device-backed feature-vector store with a dynamic ID universe.
+
+Reference: app/oryx-app-common/src/main/java/com/cloudera/oryx/app/als/
+FeatureVectors.java:28-86 (get/set vector, recent-ID tracking,
+retainRecentAndIDs, getVTV), FeatureVectorsPartition.java:36 (hash map +
+RW lock per partition), PartitionedFeatureVectors.java:43-222 (the
+serving-time sharded matrix).
+
+TPU-native design (the "dynamic ID universe on a static-shape device"
+hard part): IDs live in a host dict mapping to rows of a padded device
+array.  Single-row "UP" mutations write a host mirror and enqueue the
+row; the device copy is refreshed lazily at the next read — a batched
+scatter for few dirty rows, a full re-upload when many changed — so
+serving reads always see a consistent device snapshot and per-event
+device dispatch never happens.  Removed rows are zeroed and recycled via
+a free list; capacity grows by doubling.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Iterable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...common.lang import AutoReadWriteLock
+
+__all__ = ["FeatureVectorStore"]
+
+# above this fraction of dirty rows, re-upload the whole array instead of
+# scattering individual rows
+_FULL_UPLOAD_FRACTION = 0.5
+
+
+class FeatureVectorStore:
+    """Mutable {id -> float32[k]} map materialized as a device array."""
+
+    def __init__(self, features: int, initial_capacity: int = 1024):
+        self.features = features
+        cap = max(16, initial_capacity)
+        self._id_to_row: dict[str, int] = {}
+        self._row_to_id: list[str | None] = [None] * cap
+        self._free: list[int] = list(range(cap - 1, -1, -1))
+        self._host = np.zeros((cap, features), dtype=np.float32)
+        self._active = np.zeros(cap, dtype=bool)
+        self._dirty: set[int] = set()
+        self._device: jax.Array | None = None
+        self._device_active: jax.Array | None = None
+        self._device_version = 0
+        self._recent: set[str] = set()
+        self._lock = AutoReadWriteLock()
+
+    # -- basic map ops ------------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._lock.read():
+            return len(self._id_to_row)
+
+    def size(self) -> int:
+        return len(self)
+
+    def all_ids(self) -> list[str]:
+        with self._lock.read():
+            return list(self._id_to_row.keys())
+
+    def __contains__(self, id_: str) -> bool:
+        with self._lock.read():
+            return id_ in self._id_to_row
+
+    def get_vector(self, id_: str) -> np.ndarray | None:
+        with self._lock.read():
+            row = self._id_to_row.get(id_)
+            return None if row is None else self._host[row].copy()
+
+    def row_of(self, id_: str) -> int | None:
+        with self._lock.read():
+            return self._id_to_row.get(id_)
+
+    def id_of(self, row: int) -> str | None:
+        with self._lock.read():
+            return self._row_to_id[row] if 0 <= row < len(self._row_to_id) else None
+
+    def set_vector(self, id_: str, vector: np.ndarray) -> None:
+        vector = np.asarray(vector, dtype=np.float32)
+        with self._lock.write():
+            row = self._id_to_row.get(id_)
+            if row is None:
+                if not self._free:
+                    self._grow()
+                row = self._free.pop()
+                self._id_to_row[id_] = row
+                self._row_to_id[row] = id_
+            self._host[row] = vector
+            self._active[row] = True
+            self._dirty.add(row)
+            self._recent.add(id_)
+
+    def remove(self, id_: str) -> None:
+        with self._lock.write():
+            row = self._id_to_row.pop(id_, None)
+            if row is not None:
+                self._row_to_id[row] = None
+                self._host[row] = 0.0
+                self._active[row] = False
+                self._dirty.add(row)
+                self._free.append(row)
+
+    def retain_recent_and_ids(self, ids: Iterable[str]) -> None:
+        """Drop all IDs not in ``ids`` and not recently set; clear the
+        recent set (reference: FeatureVectors.retainRecentAndIDs — the
+        MODEL-swap grace logic)."""
+        keep = set(ids)
+        with self._lock.write():
+            keep |= self._recent
+            for id_ in [i for i in self._id_to_row if i not in keep]:
+                row = self._id_to_row.pop(id_)
+                self._row_to_id[row] = None
+                self._host[row] = 0.0
+                self._active[row] = False
+                self._dirty.add(row)
+                self._free.append(row)
+            self._recent.clear()
+
+    def _grow(self) -> None:
+        old_cap = len(self._row_to_id)
+        new_cap = old_cap * 2
+        host = np.zeros((new_cap, self.features), dtype=np.float32)
+        host[:old_cap] = self._host
+        self._host = host
+        active = np.zeros(new_cap, dtype=bool)
+        active[:old_cap] = self._active
+        self._active = active
+        self._row_to_id.extend([None] * old_cap)
+        self._free.extend(range(new_cap - 1, old_cap - 1, -1))
+        self._device = None  # force full re-upload at next sync
+        self._device_active = None
+
+    # -- device snapshot ----------------------------------------------------
+
+    def device_arrays(self) -> tuple[jax.Array, jax.Array]:
+        """(vectors, active_mask) on device, syncing pending host writes.
+
+        Few dirty rows -> one batched scatter; many -> full upload.
+        """
+        with self._lock.write():
+            cap = len(self._row_to_id)
+            if self._device is None or len(self._dirty) >= cap * _FULL_UPLOAD_FRACTION:
+                self._device = jnp.asarray(self._host)
+                self._device_active = jnp.asarray(self._active)
+                self._device_version += 1
+            elif self._dirty:
+                rows = np.fromiter(self._dirty, dtype=np.int32)
+                self._device = self._device.at[rows].set(
+                    jnp.asarray(self._host[rows]))
+                self._device_active = self._device_active.at[rows].set(
+                    jnp.asarray(self._active[rows]))
+                self._device_version += 1
+            self._dirty.clear()
+            return self._device, self._device_active
+
+    @property
+    def device_version(self) -> int:
+        """Monotonic counter bumped on every device-snapshot change; a
+        safe cache key for derived device state (unlike id() of the
+        array, which CPython can reuse after free)."""
+        with self._lock.read():
+            return self._device_version
+
+    def host_arrays(self) -> tuple[np.ndarray, np.ndarray, list[str | None]]:
+        """Copy of (vectors, active, row->id) for host-side iteration."""
+        with self._lock.read():
+            return self._host.copy(), self._active.copy(), list(self._row_to_id)
+
+    def vtv(self) -> np.ndarray:
+        """V^T V over live vectors — one device matmul (inactive rows are
+        zero and contribute nothing). Reference: FeatureVectors.getVTV."""
+        vecs, _ = self.device_arrays()
+        return np.asarray(jnp.matmul(vecs.T, vecs,
+                                     preferred_element_type=jnp.float32))
+
+    def map_vectors(self, fn: Callable[[str, np.ndarray], None]) -> None:
+        host, active, row_ids = self.host_arrays()
+        for row, id_ in enumerate(row_ids):
+            if id_ is not None and active[row]:
+                fn(id_, host[row])

@@ -1,0 +1,122 @@
+"""ALS result rescoring plugin API.
+
+Reference: app/oryx-app-api/src/main/java/com/cloudera/oryx/app/als/
+RescorerProvider.java:48 (per-endpoint hooks), Rescorer.java:24
+(rescore/isFiltered), MultiRescorer.java / MultiRescorerProvider.java:30
+(composition), loaded from comma-separated class names by
+ALSServingModelManager.loadRescorerProviders
+(…/serving/als/model/ALSServingModelManager.java:120-137).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Sequence
+
+from ...common.lang import load_instance
+
+__all__ = ["Rescorer", "RescorerProvider", "MultiRescorer",
+           "MultiRescorerProvider", "load_rescorer_providers"]
+
+
+class Rescorer(abc.ABC):
+    """Transforms scores of candidate results, or filters them out."""
+
+    @abc.abstractmethod
+    def rescore(self, item_id: str, score: float) -> float: ...
+
+    def is_filtered(self, item_id: str) -> bool:
+        return False
+
+
+class RescorerProvider(abc.ABC):
+    """Supplies Rescorers per serving endpoint; any hook may return None
+    meaning 'no rescoring'."""
+
+    def get_recommend_rescorer(self, user_id: str,
+                               args: Sequence[str]) -> Rescorer | None:
+        return None
+
+    def get_recommend_to_anonymous_rescorer(
+            self, item_ids: Sequence[str], args: Sequence[str]) -> Rescorer | None:
+        return None
+
+    def get_most_popular_items_rescorer(
+            self, args: Sequence[str]) -> Rescorer | None:
+        return None
+
+    def get_most_active_users_rescorer(
+            self, args: Sequence[str]) -> Rescorer | None:
+        return None
+
+    def get_most_similar_items_rescorer(
+            self, args: Sequence[str]) -> Rescorer | None:
+        return None
+
+
+class MultiRescorer(Rescorer):
+    """Applies several Rescorers in sequence
+    (reference: MultiRescorer.java)."""
+
+    def __init__(self, rescorers: Sequence[Rescorer]):
+        self._rescorers = list(rescorers)
+
+    def rescore(self, item_id: str, score: float) -> float:
+        for r in self._rescorers:
+            score = r.rescore(item_id, score)
+            if score != score:  # NaN filters
+                return score
+        return score
+
+    def is_filtered(self, item_id: str) -> bool:
+        return any(r.is_filtered(item_id) for r in self._rescorers)
+
+
+def _combine(rescorers: list[Rescorer | None]) -> Rescorer | None:
+    present = [r for r in rescorers if r is not None]
+    if not present:
+        return None
+    if len(present) == 1:
+        return present[0]
+    return MultiRescorer(present)
+
+
+class MultiRescorerProvider(RescorerProvider):
+    """Composes several providers (reference: MultiRescorerProvider.java:30)."""
+
+    def __init__(self, providers: Sequence[RescorerProvider]):
+        self._providers = list(providers)
+
+    def get_recommend_rescorer(self, user_id, args):
+        return _combine([p.get_recommend_rescorer(user_id, args)
+                         for p in self._providers])
+
+    def get_recommend_to_anonymous_rescorer(self, item_ids, args):
+        return _combine([p.get_recommend_to_anonymous_rescorer(item_ids, args)
+                         for p in self._providers])
+
+    def get_most_popular_items_rescorer(self, args):
+        return _combine([p.get_most_popular_items_rescorer(args)
+                         for p in self._providers])
+
+    def get_most_active_users_rescorer(self, args):
+        return _combine([p.get_most_active_users_rescorer(args)
+                         for p in self._providers])
+
+    def get_most_similar_items_rescorer(self, args):
+        return _combine([p.get_most_similar_items_rescorer(args)
+                         for p in self._providers])
+
+
+def load_rescorer_providers(class_names: str | None) -> RescorerProvider | None:
+    """Instantiate provider(s) from comma-separated import paths
+    (reference: ALSServingModelManager.loadRescorerProviders)."""
+    if not class_names:
+        return None
+    providers = [load_instance(name.strip())
+                 for name in class_names.split(",") if name.strip()]
+    if not providers:
+        return None
+    if len(providers) == 1:
+        return providers[0]
+    return MultiRescorerProvider(providers)

@@ -1,0 +1,95 @@
+"""ALS serving model manager: replays the update topic into the
+serving model.
+
+Reference: app/oryx-app-serving/src/main/java/com/cloudera/oryx/app/
+serving/als/model/ALSServingModelManager.java:45-160 — UP handling with
+known-items (:70-105), solver pre-trigger at load fraction (:96-103),
+MODEL/MODEL-REF handling with retain logic (:107-130),
+loadRescorerProviders (:142-160).
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from ...api.serving import AbstractServingModelManager
+from ...common import pmml as pmml_io
+from ...common import text as text_utils
+from ...common.config import Config
+from ...common.lang import RateLimitCheck
+from ...kafka.api import KEY_MODEL, KEY_MODEL_REF, KEY_UP
+from ..pmml_utils import read_pmml_from_update_key_message
+from .rescorer import load_rescorer_providers
+from .serving_model import ALSServingModel
+
+_log = logging.getLogger(__name__)
+
+__all__ = ["ALSServingModelManager"]
+
+
+class ALSServingModelManager(AbstractServingModelManager):
+
+    def __init__(self, config: Config):
+        super().__init__(config)
+        self.model: ALSServingModel | None = None
+        self._triggered_solver = False
+        self.rescorer_provider = load_rescorer_providers(
+            config.get_optional_string("oryx.als.rescorer-provider-class"))
+        self.sample_rate = config.get_double("oryx.als.sample-rate")
+        self.min_model_load_fraction = config.get_double(
+            "oryx.serving.min-model-load-fraction")
+        if not 0.0 < self.sample_rate <= 1.0:
+            raise ValueError("sample-rate must be in (0,1]")
+        self._log_rate_limit = RateLimitCheck(60.0)
+
+    def get_model(self) -> ALSServingModel | None:
+        return self.model
+
+    def consume_key_message(self, key: str | None, message: str) -> None:
+        if key == KEY_UP:
+            model = self.model
+            if model is None:
+                return  # no model to interpret with yet
+            update = text_utils.read_json(message)
+            kind, id_ = str(update[0]), str(update[1])
+            vector = np.asarray(update[2], dtype=np.float32)
+            if kind == "X":
+                model.set_user_vector(id_, vector)
+                if len(update) > 3:
+                    model.add_known_items(id_, [str(i) for i in update[3]])
+            elif kind == "Y":
+                model.set_item_vector(id_, vector)
+            else:
+                raise ValueError(f"Bad message: {message}")
+            if self._log_rate_limit.test():
+                _log.info("%s", model)
+                if (not self._triggered_solver
+                        and model.get_fraction_loaded()
+                        >= self.min_model_load_fraction):
+                    self._triggered_solver = True
+                    model.precompute_solvers()
+        elif key in (KEY_MODEL, KEY_MODEL_REF):
+            _log.info("Loading new model")
+            pmml = read_pmml_from_update_key_message(key, message)
+            if pmml is None:
+                return
+            features = int(pmml_io.get_extension_value(pmml, "features"))
+            implicit = pmml_io.get_extension_value(pmml, "implicit") == "true"
+            if self.model is None or features != self.model.features:
+                _log.warning("No previous model, or # features changed; "
+                             "creating new one")
+                self.model = ALSServingModel(features, implicit,
+                                             self.sample_rate,
+                                             self.rescorer_provider)
+            _log.info("Updating model")
+            x_ids = set(pmml_io.get_extension_content(pmml, "XIDs") or [])
+            y_ids = set(pmml_io.get_extension_content(pmml, "YIDs") or [])
+            self.model.set_expected_ids(list(x_ids), list(y_ids))
+            self.model.retain_recent_and_known_items(list(x_ids))
+            self.model.retain_recent_and_user_ids(list(x_ids))
+            self.model.retain_recent_and_item_ids(list(y_ids))
+            _log.info("Model updated: %s", self.model)
+        else:
+            raise ValueError(f"Bad key: {key}")

@@ -1,0 +1,227 @@
+"""The ALS batch app: MLUpdate implementation over the JAX trainer.
+
+Reference: app/oryx-app-mllib/src/main/java/com/cloudera/oryx/app/batch/
+mllib/als/ALSUpdate.java — hyperparams from config :84-101, buildModel
+:109-180 (parse -> ID-index maps -> decay -> aggregate -> factorize ->
+PMML), evaluate :200-247 (implicit mean AUC / explicit -RMSE),
+publishAdditionalModelData :287-319 (stream Y then X as "UP"-style JSON,
+user rows joined with known-items), mfModelToPMML :430-473 (X/Y as
+gzipped JSON text files + XIDs/YIDs extensions), time-based
+splitNewDataToTrainTest :326-343.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import logging
+import os
+from typing import Sequence
+from xml.etree.ElementTree import Element
+
+import numpy as np
+
+from ...common import pmml as pmml_io
+from ...common import text as text_utils
+from ...common.config import Config
+from ...common.io_utils import mkdirs, strip_scheme
+from ...kafka.api import KEY_UP, KeyMessage, TopicProducer
+from ...ml import params as hp
+from ...ml.mlupdate import MLUpdate
+from . import common as als_common
+from . import evaluation
+from .trainer import ALSModel, train_als
+
+_log = logging.getLogger(__name__)
+
+__all__ = ["ALSUpdate", "save_features", "load_features"]
+
+
+def save_features(path: str, ids: Sequence[str], matrix: np.ndarray) -> None:
+    """Write a factor matrix as gzipped JSON lines ``["id",[floats]]`` —
+    the artifact format serving/speed layers read back
+    (reference: ALSUpdate.saveFeaturesRDD :490-499)."""
+    path = mkdirs(strip_scheme(path))
+    with gzip.open(os.path.join(path, "part-00000.gz"), "wt",
+                   encoding="utf-8") as f:
+        for id_, row in zip(ids, matrix):
+            f.write(text_utils.join_json([id_, [round(float(v), 8) for v in row]]))
+            f.write("\n")
+
+
+def load_features(path: str) -> tuple[list[str], np.ndarray]:
+    """Read a factor matrix directory written by save_features
+    (reference: ALSUpdate.readFeaturesRDD :533-541)."""
+    ids: list[str] = []
+    rows: list[list[float]] = []
+    path = strip_scheme(path)
+    parts = sorted(glob.glob(os.path.join(path, "part-*")))
+    for part in parts:
+        opener = gzip.open if part.endswith(".gz") else open
+        with opener(part, "rt", encoding="utf-8") as f:
+            for line in f:
+                if line.strip():
+                    id_, vector = json.loads(line)
+                    ids.append(str(id_))
+                    rows.append(vector)
+    matrix = np.asarray(rows, dtype=np.float32) if rows else \
+        np.zeros((0, 0), dtype=np.float32)
+    return ids, matrix
+
+
+class ALSUpdate(MLUpdate):
+    """Batch ALS: factor the full interaction history each generation."""
+
+    def __init__(self, config: Config):
+        super().__init__(config)
+        self.iterations = config.get_int("oryx.als.iterations")
+        self.implicit = config.get_bool("oryx.als.implicit")
+        self.log_strength = config.get_bool("oryx.als.logStrength")
+        self.no_known_items = config.get_bool("oryx.als.no-known-items")
+        self.decay_factor = config.get_double("oryx.als.decay.factor")
+        self.decay_zero_threshold = config.get_double("oryx.als.decay.zero-threshold")
+        if self.iterations <= 0:
+            raise ValueError("iterations must be positive")
+        if not 0.0 < self.decay_factor <= 1.0:
+            raise ValueError("decay factor must be in (0,1]")
+        if self.decay_zero_threshold < 0.0:
+            raise ValueError("decay zero threshold must be >= 0")
+        self._hyper_params = [
+            hp.from_config(config, "oryx.als.hyperparams.features"),
+            hp.from_config(config, "oryx.als.hyperparams.lambda"),
+            hp.from_config(config, "oryx.als.hyperparams.alpha"),
+        ]
+        if self.log_strength:
+            self._hyper_params.append(
+                hp.from_config(config, "oryx.als.hyperparams.epsilon"))
+
+    def get_hyper_parameter_values(self) -> list[hp.HyperParamValues]:
+        return list(self._hyper_params)
+
+    # -- train --------------------------------------------------------------
+
+    def build_model(self, train_data, hyper_parameters, candidate_path) -> Element:
+        features = int(hyper_parameters[0])
+        lam = float(hyper_parameters[1])
+        alpha = float(hyper_parameters[2])
+        epsilon = float(hyper_parameters[3]) if self.log_strength else float("nan")
+        if features <= 0 or lam < 0.0 or alpha <= 0.0:
+            raise ValueError("bad hyperparameters")
+        events = als_common.parse_events(train_data, self.decay_factor,
+                                         self.decay_zero_threshold)
+        ratings = als_common.aggregate(events, self.implicit,
+                                       self.log_strength, epsilon)
+        model = train_als(ratings, features, lam, alpha, self.implicit,
+                          self.iterations)
+        return self._model_to_pmml(model, features, lam, alpha, epsilon,
+                                   candidate_path)
+
+    def _model_to_pmml(self, model: ALSModel, features: int, lam: float,
+                       alpha: float, epsilon: float,
+                       candidate_path: str) -> Element:
+        """Ad-hoc factored-matrix serialization: the PMML carries pointers
+        to the X/ Y/ artifact dirs plus the ID lists
+        (reference: mfModelToPMML :430-473)."""
+        save_features(os.path.join(candidate_path, "X"), model.user_ids, model.X)
+        save_features(os.path.join(candidate_path, "Y"), model.item_ids, model.Y)
+        doc = pmml_io.build_skeleton_pmml()
+        pmml_io.add_extension(doc, "X", "X/")
+        pmml_io.add_extension(doc, "Y", "Y/")
+        pmml_io.add_extension(doc, "features", features)
+        pmml_io.add_extension(doc, "lambda", lam)
+        pmml_io.add_extension(doc, "implicit", self.implicit)
+        if self.implicit:
+            pmml_io.add_extension(doc, "alpha", alpha)
+        pmml_io.add_extension(doc, "logStrength", self.log_strength)
+        if self.log_strength:
+            pmml_io.add_extension(doc, "epsilon", epsilon)
+        pmml_io.add_extension_content(doc, "XIDs", model.user_ids)
+        pmml_io.add_extension_content(doc, "YIDs", model.item_ids)
+        return doc
+
+    # -- evaluate -----------------------------------------------------------
+
+    def evaluate(self, model: Element, candidate_path: str,
+                 test_data, train_data) -> float:
+        x_ids, X = load_features(os.path.join(candidate_path, "X"))
+        y_ids, Y = load_features(os.path.join(candidate_path, "Y"))
+        uidx = {u: j for j, u in enumerate(x_ids)}
+        iidx = {i: j for j, i in enumerate(y_ids)}
+
+        epsilon = float("nan")
+        if self.log_strength:
+            epsilon = float(pmml_io.get_extension_value(model, "epsilon"))
+        events = als_common.parse_events(test_data, self.decay_factor,
+                                         self.decay_zero_threshold)
+        test = als_common.aggregate(events, self.implicit,
+                                    self.log_strength, epsilon)
+        # keep only test pairs whose user and item exist in the model
+        users, items, values = [], [], []
+        for u_i, i_i, v in zip(test.users, test.items, test.values):
+            u_id = test.user_ids[u_i]
+            i_id = test.item_ids[i_i]
+            if u_id in uidx and i_id in iidx:
+                users.append(uidx[u_id])
+                items.append(iidx[i_id])
+                values.append(v)
+        if not users:
+            return 0.0 if self.implicit else float("-inf")
+        users = np.asarray(users, dtype=np.int32)
+        items = np.asarray(items, dtype=np.int32)
+        values = np.asarray(values, dtype=np.float32)
+        if self.implicit:
+            auc = evaluation.area_under_curve(X, Y, users, items)
+            _log.info("AUC: %s", auc)
+            return auc
+        err = evaluation.rmse(X, Y, users, items, values)
+        _log.info("RMSE: %s", err)
+        return -err
+
+    # -- publish ------------------------------------------------------------
+
+    def can_publish_additional_model_data(self) -> bool:
+        return True
+
+    def publish_additional_model_data(self, model: Element, new_data, past_data,
+                                      model_path: str,
+                                      model_update_topic: TopicProducer) -> None:
+        """Stream every factor row as an "UP" message — items first so
+        user endpoints return complete results once they stop 404ing
+        (reference: publishAdditionalModelData :287-319)."""
+        y_rel = pmml_io.get_extension_value(model, "Y")
+        y_ids, Y = load_features(os.path.join(model_path, y_rel))
+        for id_, row in zip(y_ids, Y):
+            model_update_topic.send(KEY_UP, text_utils.join_json(
+                ["Y", id_, [float(v) for v in row]]))
+
+        x_rel = pmml_io.get_extension_value(model, "X")
+        x_ids, X = load_features(os.path.join(model_path, x_rel))
+        if self.no_known_items:
+            for id_, row in zip(x_ids, X):
+                model_update_topic.send(KEY_UP, text_utils.join_json(
+                    ["X", id_, [float(v) for v in row]]))
+        else:
+            all_events = als_common.parse_events(
+                list(new_data) + list(past_data), 1.0, 0.0)
+            known = als_common.build_known_items(all_events)
+            for id_, row in zip(x_ids, X):
+                model_update_topic.send(KEY_UP, text_utils.join_json(
+                    ["X", id_, [float(v) for v in row],
+                     sorted(known.get(id_, ()))]))
+
+    # -- split --------------------------------------------------------------
+
+    def split_new_data_to_train_test(self, new_data):
+        """Split solely on time: earliest (1 - test_fraction) of the
+        timestamp range trains, the most recent tail tests
+        (reference: splitNewDataToTrainTest :326-343)."""
+        def ts(km: KeyMessage) -> int:
+            return als_common.parse_events([km], 1.0, 0.0)[0][3]
+
+        stamps = [ts(km) for km in new_data]
+        min_t, max_t = min(stamps), max(stamps)
+        boundary = max_t - self.test_fraction * (max_t - min_t)
+        train = [km for km, t in zip(new_data, stamps) if t < boundary]
+        test = [km for km, t in zip(new_data, stamps) if t >= boundary]
+        return train, test

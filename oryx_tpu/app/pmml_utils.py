@@ -1,0 +1,32 @@
+"""App-tier PMML helpers.
+
+Reference: app/oryx-app-common/src/main/java/com/cloudera/oryx/app/pmml/
+AppPMMLUtils.java — readPMMLFromUpdateKeyMessage :259 (MODEL = inline
+XML; MODEL-REF = storage path, missing file tolerated with a warning).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from xml.etree.ElementTree import Element
+
+from ..common import pmml as pmml_io
+from ..common.io_utils import strip_scheme
+from ..kafka.api import KEY_MODEL, KEY_MODEL_REF
+
+_log = logging.getLogger(__name__)
+
+__all__ = ["read_pmml_from_update_key_message"]
+
+
+def read_pmml_from_update_key_message(key: str, message: str) -> Element | None:
+    if key == KEY_MODEL:
+        return pmml_io.from_string(message)
+    if key == KEY_MODEL_REF:
+        path = strip_scheme(message)
+        if not os.path.exists(path):
+            _log.warning("Unable to load model file at %s; ignoring", path)
+            return None
+        return pmml_io.read(path)
+    raise ValueError(f"Bad key: {key}")

@@ -45,12 +45,24 @@ _OFFSET_FLUSH_SEC = 0.1
 
 
 def get_broker(name: str = "default", persist_dir: str | None = None) -> "InProcBroker":
-    """The shared named broker, creating it on first use."""
+    """The shared named broker, creating it on first use.
+
+    Requesting a persist_dir different from the one the broker was
+    created with is an error — silently returning a non-persistent
+    broker would make durability depend on construction order.
+    """
     with _REGISTRY_LOCK:
         broker = _REGISTRY.get(name)
         if broker is None:
             broker = InProcBroker(name=name, persist_dir=persist_dir)
             _REGISTRY[name] = broker
+        elif persist_dir is not None and (
+                broker._persist_dir is None
+                or os.path.abspath(broker._persist_dir)
+                != os.path.abspath(persist_dir)):
+            raise ValueError(
+                f"broker {name!r} already exists with persist_dir="
+                f"{broker._persist_dir!r}, requested {persist_dir!r}")
         return broker
 
 
@@ -118,6 +130,7 @@ class InProcBroker:
         self._offsets_path = (os.path.join(self._persist_dir, "offsets.json")
                               if self._persist_dir else None)
         self._offsets_dirty_since: float | None = None
+        self._offsets_last_write = 0.0
         if self._offsets_path and os.path.exists(self._offsets_path):
             with open(self._offsets_path, encoding="utf-8") as f:
                 self._offsets = {tuple(k.split("\x00", 1)): v  # type: ignore[misc]
@@ -191,35 +204,34 @@ class InProcBroker:
         else:
             pos = 0 if from_beginning else t.latest_offset()
         idle_since = time.monotonic()
-        while True:
-            with t.cond:
-                while pos >= len(t.log):
-                    if stop is not None and stop.is_set():
-                        return
-                    if (max_idle_sec is not None
-                            and time.monotonic() - idle_since > max_idle_sec):
-                        return
-                    t.cond.wait(poll_timeout_sec)
-                key, message = t.log[pos]
-            pos += 1
-            idle_since = time.monotonic()
-            # Commit AFTER the consumer's processing (the code between
-            # yields) so a failure mid-processing redelivers: at-least-once,
-            # matching the reference's commit-after-batch ordering
-            # (UpdateOffsetsFn.java:37-64).  A graceful break/close
-            # (GeneratorExit) means the message WAS processed — commit;
-            # an exception propagating through the consumer means it
-            # wasn't — don't.
-            try:
+        try:
+            while True:
+                with t.cond:
+                    while pos >= len(t.log):
+                        if stop is not None and stop.is_set():
+                            return
+                        if (max_idle_sec is not None
+                                and time.monotonic() - idle_since > max_idle_sec):
+                            return
+                        t.cond.wait(poll_timeout_sec)
+                    key, message = t.log[pos]
+                pos += 1
+                idle_since = time.monotonic()
+                # Commit only after the consumer's processing (the code
+                # between yields) completes and it comes back for more:
+                # at-least-once, matching the reference's
+                # commit-after-batch ordering (UpdateOffsetsFn.java:37-64).
+                # A consumer that breaks or crashes mid-processing leaves
+                # the in-flight message uncommitted, so a restart
+                # redelivers it — duplicates are possible, loss is not.
                 yield KeyMessage(key, message)
-            except GeneratorExit:
                 if group is not None:
                     self.set_offset(group, topic, pos)
-                raise
+                if stop is not None and stop.is_set():
+                    return
+        finally:
             if group is not None:
-                self.set_offset(group, topic, pos)
-            if stop is not None and stop.is_set():
-                return
+                self.flush()
 
     # -- offsets (ZK offset-store parity) -----------------------------------
 
@@ -230,29 +242,27 @@ class InProcBroker:
     def set_offset(self, group: str, topic: str, offset: int) -> None:
         with self._lock:
             self._offsets[(group, topic)] = offset
-            # throttled write-behind: losing the last few commits on crash
-            # only causes redelivery, which at-least-once already allows
-            if self._offsets_path and (self._offsets_dirty_since is None):
-                self._offsets_dirty_since = time.monotonic()
-            if self._offsets_path and (
-                    time.monotonic() - self._offsets_dirty_since
-                    >= _OFFSET_FLUSH_SEC
-                    or offset >= self.latest_offset_unlocked(topic)):
-                self._write_offsets_locked()
-
-    def latest_offset_unlocked(self, topic: str) -> int:
-        t = self._topics.get(topic)
-        return len(t.log) if t else 0
+            # time-throttled write-behind: losing the last interval's
+            # commits on crash only causes redelivery, which the
+            # at-least-once contract already allows.  Consumers flush()
+            # on exit (consume's finally) to bound the window.
+            if self._offsets_path:
+                self._offsets_dirty_since = self._offsets_dirty_since or time.monotonic()
+                if (time.monotonic() - self._offsets_last_write
+                        >= _OFFSET_FLUSH_SEC):
+                    self._write_offsets_locked()
 
     def _write_offsets_locked(self) -> None:
         if self._offsets_path:
             with open(self._offsets_path, "w", encoding="utf-8") as f:
                 json.dump({"\x00".join(k): v for k, v in self._offsets.items()}, f)
             self._offsets_dirty_since = None
+            self._offsets_last_write = time.monotonic()
 
     def flush(self) -> None:
         with self._lock:
-            self._write_offsets_locked()
+            if self._offsets_dirty_since is not None:
+                self._write_offsets_locked()
 
     def fill_in_latest_offsets(self, group: str, topics: list[str]) -> None:
         """For any topic without a committed offset, commit the latest —

@@ -156,16 +156,17 @@ def from_config(config: Config, key: str) -> HyperParamValues:
     (int preferred over double over string)."""
     v = config.get(key)
     if isinstance(v, list):
-        try:
-            if len(v) == 2:
-                return range_values(int(str(v[0])), int(str(v[1])))
-        except ValueError:
-            pass
-        try:
-            if len(v) == 2:
-                return range_values(float(str(v[0])), float(str(v[1])))
-        except ValueError:
-            pass
+        if len(v) == 2:
+            # only parse failures fall through to 'unordered'; a reversed
+            # numeric range like [8, 2] is a config error and propagates
+            try:
+                lo, hi = int(str(v[0])), int(str(v[1]))
+            except ValueError:
+                try:
+                    lo, hi = float(str(v[0])), float(str(v[1]))
+                except ValueError:
+                    return unordered(list(v))
+            return range_values(lo, hi)
         # unordered values keep their native types (ints stay ints)
         return unordered(list(v))
     s = str(v)

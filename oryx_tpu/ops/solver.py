@@ -105,8 +105,8 @@ def get_solver(a) -> Solver:
     inf_norm = float(np.max(np.sum(np.abs(a), axis=1))) if a.size else 0.0
     threshold = inf_norm * _SINGULARITY_THRESHOLD_RATIO
     svals = np.linalg.svd(a, compute_uv=False)
+    apparent_rank = int(np.sum(svals > 0.01 * (svals[0] if svals.size else 0.0)))
     if svals.size == 0 or svals[-1] <= threshold:
-        apparent_rank = int(np.sum(svals > 0.01 * (svals[0] if svals.size else 0.0)))
         raise SingularMatrixSolverException(
             apparent_rank,
             f"{a.shape[0]} x {a.shape[1]} matrix is near-singular "
@@ -116,7 +116,7 @@ def get_solver(a) -> Solver:
     # PD can still pass the SVD singularity gate) — reject it here
     # rather than let NaN propagate into every later solve
     if bool(jnp.any(jnp.isnan(chol))):
-        rank = int(np.sum(svals > 0.01 * svals[0]))
         raise SingularMatrixSolverException(
-            rank, f"matrix is not positive definite; apparent rank: {rank}")
+            apparent_rank,
+            f"matrix is not positive definite; apparent rank: {apparent_rank}")
     return Solver(chol)

@@ -1,0 +1,497 @@
+"""ALS app tests (reference analogs: ALSUtilsTest, ALSUpdateIT,
+ALSSpeedIT, ALSServingModelTest, ALSServingModelManagerIT,
+LocalitySensitiveHashTest)."""
+
+import math
+import os
+
+import numpy as np
+import pytest
+
+from oryx_tpu.app.als import common as als_common
+from oryx_tpu.app.als import evaluation
+from oryx_tpu.app.als.feature_vectors import FeatureVectorStore
+from oryx_tpu.app.als.lsh import LocalitySensitiveHash, choose_hash_count
+from oryx_tpu.app.als.serving_manager import ALSServingModelManager
+from oryx_tpu.app.als.serving_model import ALSServingModel, SolverCache
+from oryx_tpu.app.als.speed import ALSSpeedModelManager
+from oryx_tpu.app.als.trainer import train_als, predict_pairs
+from oryx_tpu.app.als.update import ALSUpdate, load_features, save_features
+from oryx_tpu.common import pmml as pmml_io
+from oryx_tpu.common.config import from_dict
+from oryx_tpu.kafka.api import KEY_MODEL, KEY_UP, KeyMessage
+from oryx_tpu.kafka.inproc import InProcBroker, InProcTopicProducer, get_broker
+
+
+# -- common: parse/aggregate/known ------------------------------------------
+
+def test_aggregate_implicit_sums_and_deletes():
+    events = [("u", "i", 1.0, 1), ("u", "i", 2.0, 2), ("u", "j", 1.0, 3),
+              ("v", "i", float("nan"), 4)]
+    r = als_common.aggregate(events, implicit=True)
+    pairs = {(r.user_ids[u], r.item_ids[i]): v
+             for u, i, v in zip(r.users, r.items, r.values)}
+    assert pairs[("u", "i")] == 3.0
+    assert pairs[("u", "j")] == 1.0
+    assert ("v", "i") not in pairs  # delete wiped the pair
+
+
+def test_aggregate_implicit_delete_after_add():
+    events = [("u", "i", 1.0, 1), ("u", "i", float("nan"), 2)]
+    r = als_common.aggregate(events, implicit=True)
+    assert len(r.values) == 0
+
+
+def test_aggregate_explicit_last_wins():
+    events = [("u", "i", 3.0, 1), ("u", "i", 5.0, 2)]
+    r = als_common.aggregate(events, implicit=False)
+    assert list(r.values) == [5.0]
+
+
+def test_decay():
+    day_ms = 86_400_000
+    assert als_common.decay_value(1.0, 0, 3 * day_ms, 0.9) == pytest.approx(0.9 ** 3)
+    assert als_common.decay_value(1.0, 5, 5, 0.9) == 1.0  # not older than now
+
+
+def test_known_items_delete():
+    events = [("u", "a", 1.0, 1), ("u", "b", 1.0, 2), ("u", "a", float("nan"), 3)]
+    known = als_common.build_known_items(events)
+    assert known["u"] == {"b"}
+
+
+def test_parse_events_orders_by_timestamp():
+    msgs = [KeyMessage(None, "u,i,1,300"), KeyMessage(None, "u,j,1,100")]
+    events = als_common.parse_events(msgs)
+    assert [e[3] for e in events] == [100, 300]
+
+
+# -- trainer ----------------------------------------------------------------
+
+def _synthetic_explicit(nu=120, ni=60, k=3, density=0.35, seed=0):
+    rng = np.random.default_rng(seed)
+    Xt = rng.standard_normal((nu, k))
+    Yt = rng.standard_normal((ni, k))
+    R = Xt @ Yt.T
+    mask = rng.random((nu, ni)) < density
+    us, its = np.nonzero(mask)
+    return als_common.ParsedRatings(
+        [f"u{i}" for i in range(nu)], [f"i{j}" for j in range(ni)],
+        us.astype(np.int32), its.astype(np.int32),
+        R[us, its].astype(np.float32)), R, mask
+
+
+def test_train_als_explicit_recovers_low_rank():
+    ratings, R, mask = _synthetic_explicit()
+    m = train_als(ratings, features=3, lam=0.01, alpha=1.0, implicit=False,
+                  iterations=6, seed=1)
+    pred = predict_pairs(m.X, m.Y, ratings.users, ratings.items)
+    rmse = float(np.sqrt(np.mean((pred - ratings.values) ** 2)))
+    assert rmse < 0.1
+    # held-out generalization
+    held = ~mask & (np.random.default_rng(9).random(mask.shape) < 0.05)
+    u2, i2 = np.nonzero(held)
+    p2 = predict_pairs(m.X, m.Y, u2.astype(np.int32), i2.astype(np.int32))
+    assert float(np.sqrt(np.mean((p2 - R[u2, i2]) ** 2))) < 0.3
+
+
+def test_train_als_implicit_ranks_positives_higher():
+    ratings, R, _ = _synthetic_explicit(seed=3)
+    pos = R > 1.0
+    us, its = np.nonzero(pos)
+    r = als_common.ParsedRatings(ratings.user_ids, ratings.item_ids,
+                                 us.astype(np.int32), its.astype(np.int32),
+                                 np.ones(len(us), np.float32))
+    m = train_als(r, 3, 0.01, 1.0, True, 5, seed=2)
+    s = m.X @ m.Y.T
+    assert float(s[pos].mean()) > float(s[~pos].mean()) + 0.3
+
+
+def test_evaluation_auc_perfect_and_random():
+    # construct scores where positives always outrank: AUC ~ 1
+    X = np.eye(4, dtype=np.float32)
+    Y = np.vstack([np.eye(4), -np.eye(4)]).astype(np.float32)
+    users = np.arange(4, dtype=np.int32)
+    items = np.arange(4, dtype=np.int32)  # item i == best for user i
+    auc = evaluation.area_under_curve(X, Y, users, items)
+    assert auc > 0.9
+
+
+# -- artifacts --------------------------------------------------------------
+
+def test_save_load_features_round_trip(tmp_path):
+    ids = ["a", "b", "c"]
+    mat = np.array([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]], dtype=np.float32)
+    save_features(str(tmp_path / "X"), ids, mat)
+    ids2, mat2 = load_features(str(tmp_path / "X"))
+    assert ids2 == ids
+    np.testing.assert_allclose(mat2, mat, rtol=1e-6)
+
+
+# -- feature store ----------------------------------------------------------
+
+def test_feature_store_basics():
+    fs = FeatureVectorStore(2, initial_capacity=4)
+    fs.set_vector("a", [1.0, 2.0])
+    fs.set_vector("b", [3.0, 4.0])
+    assert len(fs) == 2
+    np.testing.assert_array_equal(fs.get_vector("a"), [1.0, 2.0])
+    fs.remove("a")
+    assert fs.get_vector("a") is None
+    # grow beyond capacity
+    for i in range(10):
+        fs.set_vector(f"x{i}", [float(i), 0.0])
+    assert len(fs) == 11
+    vecs, active = fs.device_arrays()
+    assert int(np.asarray(active).sum()) == 11
+
+
+def test_feature_store_retain_recent():
+    fs = FeatureVectorStore(2)
+    fs.set_vector("old1", [1, 1])
+    fs.set_vector("old2", [2, 2])
+    fs.device_arrays()
+    fs._recent.clear()  # simulate time passing: nothing recent
+    fs.set_vector("recent", [3, 3])
+    fs.retain_recent_and_ids(["old1"])
+    assert "old1" in fs and "recent" in fs and "old2" not in fs
+
+
+def test_feature_store_vtv():
+    fs = FeatureVectorStore(2)
+    fs.set_vector("a", [1.0, 2.0])
+    fs.set_vector("b", [3.0, 4.0])
+    expected = np.array([[1, 2], [3, 4]], dtype=np.float32)
+    np.testing.assert_allclose(fs.vtv(), expected.T @ expected, rtol=1e-5)
+
+
+def test_feature_store_incremental_device_sync():
+    fs = FeatureVectorStore(2, initial_capacity=64)
+    for i in range(20):
+        fs.set_vector(f"v{i}", [float(i), 1.0])
+    v1, _ = fs.device_arrays()
+    fs.set_vector("v3", [99.0, 99.0])  # single dirty row -> scatter path
+    v2, _ = fs.device_arrays()
+    row = fs.row_of("v3")
+    np.testing.assert_array_equal(np.asarray(v2)[row], [99.0, 99.0])
+
+
+# -- LSH --------------------------------------------------------------------
+
+def test_choose_hash_count_full_sample():
+    nh, _ = choose_hash_count(1.0, 8)
+    assert nh <= 3  # near-trivial hashing at sample rate 1.0
+
+
+def test_lsh_masks_fraction_of_items():
+    lsh = LocalitySensitiveHash(0.3, 8, num_cores=8)
+    assert lsh.num_hashes > 0
+    rng = np.random.default_rng(5)
+    items = rng.standard_normal((2000, 8)).astype(np.float32)
+    import jax.numpy as jnp
+    buckets = jnp.asarray(lsh.bucket_of(items))
+    q = rng.standard_normal(8).astype(np.float32)
+    mask = np.asarray(lsh.candidate_mask(q, buckets))
+    frac = mask.mean()
+    assert 0.02 < frac < 0.8  # prunes, but keeps a viable candidate set
+    # query's own bucket always included: a vector equal to an item
+    mask_self = np.asarray(lsh.candidate_mask(np.asarray(items[0]), buckets))
+    assert mask_self[0]
+
+
+# -- serving model ----------------------------------------------------------
+
+def _make_serving_model(nu=20, ni=50, k=4, seed=0):
+    rng = np.random.default_rng(seed)
+    model = ALSServingModel(k, implicit=True)
+    X = rng.standard_normal((nu, k)).astype(np.float32)
+    Y = rng.standard_normal((ni, k)).astype(np.float32)
+    for i in range(nu):
+        model.set_user_vector(f"u{i}", X[i])
+    for j in range(ni):
+        model.set_item_vector(f"i{j}", Y[j])
+    return model, X, Y
+
+
+def test_top_n_matches_numpy():
+    model, X, Y = _make_serving_model()
+    got = model.top_n(5, user_vector=X[0])
+    scores = Y @ X[0]
+    want_idx = np.argsort(-scores)[:5]
+    assert [g[0] for g in got] == [f"i{j}" for j in want_idx]
+    np.testing.assert_allclose([g[1] for g in got], scores[want_idx], rtol=1e-5)
+
+
+def test_top_n_excludes_known_items():
+    model, X, Y = _make_serving_model()
+    scores = Y @ X[0]
+    best = f"i{int(np.argmax(scores))}"
+    got = model.top_n(5, user_vector=X[0], exclude={best})
+    assert best not in [g[0] for g in got]
+    assert len(got) == 5
+
+
+def test_top_n_cosine_and_lowest():
+    model, X, Y = _make_serving_model()
+    v = Y[7]
+    got = model.top_n(3, cosine_to=v)
+    # the item itself has cosine 1.0 -> top
+    assert got[0][0] == "i7"
+    assert got[0][1] == pytest.approx(1.0, abs=1e-5)
+    low = model.top_n(3, user_vector=X[0], lowest=True)
+    scores = Y @ X[0]
+    assert low[0][0] == f"i{int(np.argmin(scores))}"
+
+
+def test_top_n_with_rescorer():
+    from oryx_tpu.app.als.rescorer import Rescorer
+
+    class Halver(Rescorer):
+        def rescore(self, item_id, score):
+            return score * 0.5
+
+        def is_filtered(self, item_id):
+            return item_id == "i0"
+
+    model, X, Y = _make_serving_model()
+    got = model.top_n(5, user_vector=X[0], rescorer=Halver())
+    assert "i0" not in [g[0] for g in got]
+    scores = (Y @ X[0]) * 0.5
+    order = [f"i{j}" for j in np.argsort(-scores) if j != 0][:5]
+    assert [g[0] for g in got] == order
+
+
+def test_fraction_loaded_and_retain():
+    model, X, Y = _make_serving_model(nu=4, ni=4)
+    assert model.get_fraction_loaded() == 1.0
+    model.set_expected_ids(["u0", "new1", "new2"], ["i0"])
+    # u0/i0 already loaded; new1,new2 expected -> 8/(8+2)
+    assert model.get_fraction_loaded() == pytest.approx(8 / 10)
+    model.add_known_items("u0", ["i1"])
+    model.add_known_items("gone", ["i2"])
+    model.retain_recent_and_known_items(["u0"])
+    assert model.get_known_items("gone") == set()
+    assert model.get_known_items("u0") == {"i1"}
+
+
+def test_top_n_lowest_with_rescorer():
+    from oryx_tpu.app.als.rescorer import Rescorer
+
+    class Identity(Rescorer):
+        def rescore(self, item_id, score):
+            return score
+
+    model, X, Y = _make_serving_model()
+    got = model.top_n(3, user_vector=X[0], lowest=True, rescorer=Identity())
+    scores = Y @ X[0]
+    want = [f"i{j}" for j in np.argsort(scores)[:3]]
+    assert [g[0] for g in got] == want
+
+
+def test_solver_cache_returns_none_fast_when_singular():
+    import time as _time
+    cache = SolverCache(lambda: np.zeros((3, 3)))  # always singular
+    t0 = _time.monotonic()
+    assert cache.get(blocking=True) is None
+    assert _time.monotonic() - t0 < 5.0  # no stall waiting on a timeout
+
+
+def test_aggregate_log_strength_domain():
+    # a pair whose sum is far negative must drop, not crash the build
+    events = [("u", "i", -5.0, 1), ("u", "j", 2.0, 2)]
+    r = als_common.aggregate(events, implicit=True, log_strength=True,
+                             epsilon=1e-5)
+    assert len(r.values) == 1  # only the positive pair survives
+    assert r.values[0] == pytest.approx(math.log1p(2.0 / 1e-5))
+
+
+def test_solver_cache_dirty_refresh():
+    calls = []
+
+    def supplier():
+        calls.append(1)
+        return np.eye(3) * (len(calls) + 1.0)
+
+    cache = SolverCache(supplier)
+    s1 = cache.get(blocking=True)
+    assert s1 is not None and len(calls) == 1
+    s2 = cache.get(blocking=True)
+    assert len(calls) == 1  # not dirty: cached
+    cache.set_dirty()
+    cache.compute_now()
+    assert len(calls) == 2
+
+
+# -- ALSUpdate end-to-end (ALSUpdateIT level) --------------------------------
+
+def _ratings_lines(seed=0, nu=60, ni=30, k=3):
+    rng = np.random.default_rng(seed)
+    Xt = rng.standard_normal((nu, k))
+    Yt = rng.standard_normal((ni, k))
+    R = Xt @ Yt.T
+    lines = []
+    t = 1_500_000_000_000
+    for u in range(nu):
+        for i in range(ni):
+            if R[u, i] > 0.5:
+                lines.append(KeyMessage(None, f"u{u},i{i},{R[u, i]:.3f},{t}"))
+                t += 1000
+    return lines
+
+
+def test_als_update_end_to_end(tmp_path):
+    cfg = from_dict({
+        "oryx.als.iterations": 5,
+        "oryx.als.implicit": True,
+        "oryx.als.hyperparams.features": 4,
+        "oryx.ml.eval.test-fraction": 0.2,
+    })
+    update = ALSUpdate(cfg)
+    data = _ratings_lines()
+    broker_name = "als-e2e"
+    producer = InProcTopicProducer(f"memory://{broker_name}", "Up")
+    model_dir = str(tmp_path / "model")
+    update.run_update(0, data, [], model_dir, producer)
+
+    broker = get_broker(broker_name)
+    msgs = list(broker.consume("Up", from_beginning=True, max_idle_sec=0.2))
+    # first a MODEL, then Y rows, then X rows with known-items
+    assert msgs[0].key == KEY_MODEL
+    doc = pmml_io.from_string(msgs[0].message)
+    assert pmml_io.get_extension_value(doc, "features") == "4"
+    assert pmml_io.get_extension_value(doc, "implicit") == "true"
+    x_ids = pmml_io.get_extension_content(doc, "XIDs")
+    y_ids = pmml_io.get_extension_content(doc, "YIDs")
+    assert len(x_ids) > 0 and len(y_ids) > 0
+    ups = [m for m in msgs if m.key == KEY_UP]
+    kinds = [als_common.text_utils.read_json(m.message)[0] for m in ups]
+    assert kinds.count("Y") == len(y_ids)
+    assert kinds.count("X") == len(x_ids)
+    # Y updates come before X updates (reference ordering)
+    assert kinds.index("X") > kinds.index("Y")
+    # X updates carry known items
+    first_x = als_common.text_utils.read_json(
+        ups[kinds.index("X")].message)
+    assert len(first_x) == 4 and isinstance(first_x[3], list)
+    # artifacts exist under the published model dir
+    gen_dirs = [d for d in os.listdir(model_dir) if d.isdigit()]
+    assert len(gen_dirs) == 1
+    assert os.path.exists(os.path.join(model_dir, gen_dirs[0], "X",
+                                       "part-00000.gz"))
+
+
+def test_als_time_based_split():
+    cfg = from_dict({"oryx.ml.eval.test-fraction": 0.25})
+    update = ALSUpdate(cfg)
+    data = [KeyMessage(None, f"u,i,1,{1000 + i}") for i in range(100)]
+    train, test = update.split_new_data_to_train_test(data)
+    assert len(test) == pytest.approx(25, abs=2)
+    max_train_ts = max(int(km.message.split(",")[3]) for km in train)
+    min_test_ts = min(int(km.message.split(",")[3]) for km in test)
+    assert max_train_ts < min_test_ts  # split purely on time
+
+
+# -- speed layer (ALSSpeedIT level) -----------------------------------------
+
+def _speed_manager_with_model(nu=12, ni=12, k=3, seed=4):
+    rng = np.random.default_rng(seed)
+    cfg = from_dict({})
+    mgr = ALSSpeedModelManager(cfg)
+    doc = pmml_io.build_skeleton_pmml()
+    pmml_io.add_extension(doc, "features", k)
+    pmml_io.add_extension(doc, "implicit", True)
+    pmml_io.add_extension(doc, "logStrength", False)
+    x_ids = [f"u{i}" for i in range(nu)]
+    y_ids = [f"i{j}" for j in range(ni)]
+    pmml_io.add_extension_content(doc, "XIDs", x_ids)
+    pmml_io.add_extension_content(doc, "YIDs", y_ids)
+    mgr.consume_key_message(KEY_MODEL, pmml_io.to_string(doc))
+    # small-norm vectors keep every current estimate below 1 so implicit
+    # fold-in always has a non-NaN target
+    X = (0.3 * rng.standard_normal((nu, k))).astype(np.float32)
+    Y = (0.3 * rng.standard_normal((ni, k))).astype(np.float32)
+    for i, id_ in enumerate(x_ids):
+        mgr.consume_key_message(
+            KEY_UP, als_common.text_utils.join_json(
+                ["X", id_, [float(v) for v in X[i]]]))
+    for j, id_ in enumerate(y_ids):
+        mgr.consume_key_message(
+            KEY_UP, als_common.text_utils.join_json(
+                ["Y", id_, [float(v) for v in Y[j]]]))
+    return mgr, X, Y
+
+
+def test_speed_manager_builds_fold_in_updates():
+    mgr, X, Y = _speed_manager_with_model()
+    assert mgr.model.get_fraction_loaded() == 1.0
+    new_data = [KeyMessage(None, "u0,i1,2.5,1000"),
+                KeyMessage(None, "unew,i2,1.0,2000")]
+    updates = list(mgr.build_updates(new_data))
+    assert updates
+    parsed = [als_common.text_utils.read_json(u) for u in updates]
+    # updates reference both matrices and include the other-id as known
+    kinds = {p[0] for p in parsed}
+    assert kinds <= {"X", "Y"}
+    x_up = [p for p in parsed if p[0] == "X" and p[1] == "u0"]
+    assert x_up and x_up[0][3] == ["i1"]
+    # new user gets a vector from nothing (fold-in from 'don't know')
+    assert any(p[0] == "X" and p[1] == "unew" for p in parsed)
+    # the update moves u0's estimate for i1 upward toward 1
+    old_est = float(X[0] @ Y[1])
+    new_xu = np.asarray(x_up[0][2], dtype=np.float32)
+    new_est = float(new_xu @ Y[1])
+    if old_est < 1.0:
+        assert new_est > old_est
+
+
+def test_speed_manager_skips_without_model():
+    mgr = ALSSpeedModelManager(from_dict({}))
+    assert list(mgr.build_updates([KeyMessage(None, "u,i,1,1")])) == []
+    # UP before MODEL silently ignored
+    mgr.consume_key_message(KEY_UP, '["X","u",[0.1,0.2]]')
+    assert mgr.model is None
+
+
+def test_speed_model_feature_change_resets():
+    mgr, _, _ = _speed_manager_with_model(k=3)
+    doc = pmml_io.build_skeleton_pmml()
+    pmml_io.add_extension(doc, "features", 5)
+    pmml_io.add_extension(doc, "implicit", True)
+    pmml_io.add_extension(doc, "logStrength", False)
+    pmml_io.add_extension_content(doc, "XIDs", ["u0"])
+    pmml_io.add_extension_content(doc, "YIDs", ["i0"])
+    mgr.consume_key_message(KEY_MODEL, pmml_io.to_string(doc))
+    assert mgr.model.features == 5
+    assert len(mgr.model.X) == 0  # fresh model
+
+
+# -- serving manager (ALSServingModelManagerIT level) ------------------------
+
+def test_serving_manager_full_replay(tmp_path):
+    # run a real batch update, then replay its topic into a serving manager
+    cfg = from_dict({
+        "oryx.als.iterations": 3,
+        "oryx.als.implicit": True,
+        "oryx.als.hyperparams.features": 3,
+        "oryx.ml.eval.test-fraction": 0.0,
+    })
+    data = _ratings_lines(seed=7, nu=25, ni=15)
+    producer = InProcTopicProducer("memory://als-serve-replay", "Up2")
+    ALSUpdate(cfg).run_update(0, data, [], str(tmp_path / "m"), producer)
+
+    mgr = ALSServingModelManager(cfg)
+    broker = get_broker("als-serve-replay")
+    for km in broker.consume("Up2", from_beginning=True, max_idle_sec=0.2):
+        mgr.consume_key_message(km.key, km.message)
+    model = mgr.get_model()
+    assert model is not None
+    assert model.get_fraction_loaded() == 1.0
+    assert model.user_count() > 0 and model.item_count() > 0
+    # a user's recommendations exclude nothing by default and score sanely
+    uid = model.all_user_ids()[0]
+    recs = model.top_n(5, user_vector=model.get_user_vector(uid))
+    assert len(recs) == 5
+    assert all(isinstance(r[0], str) for r in recs)
+    # known items were delivered with X updates
+    counts = model.get_known_item_counts()
+    assert counts and all(v > 0 for v in counts.values())
