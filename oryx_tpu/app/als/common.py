@@ -71,10 +71,9 @@ def parse_events(data: Iterable[KeyMessage | str],
         user, item, value, ts = _parse_line(line)
         if decay_factor < 1.0 and not math.isnan(value):
             value = decay_value(value, ts, now_ms, decay_factor)
+        # decayed to nothing -> drop; NaN (delete) compares False and is kept
         if decay_zero_threshold > 0.0 and value <= decay_zero_threshold:
-            # decayed to nothing -> drop (NaN compares False: deletes kept)
-            if not math.isnan(value):
-                continue
+            continue
         out.append((user, item, value, ts))
     out.sort(key=lambda t: t[3])
     return out
@@ -101,12 +100,17 @@ def aggregate(events: Sequence[tuple[str, str, float, int]],
     pairs = [(k, v) for k, v in agg.items() if not math.isnan(v)]
 
     if log_strength:
+        if not epsilon > 0.0:
+            raise ValueError(f"epsilon must be positive: {epsilon}")
         # log1p(v/eps) is undefined for v <= -eps; treat as NaN (the
         # reference's Math.log1p yields NaN rather than raising) and
         # drop the pair instead of aborting the whole build
-        pairs = [(k, math.log1p(v / epsilon)) if v / epsilon > -1.0
-                 else (k, float("nan")) for k, v in pairs]
-        pairs = [(k, v) for k, v in pairs if not math.isnan(v)]
+        def _log1p_or_nan(v: float) -> float:
+            ratio = v / epsilon
+            return math.log1p(ratio) if ratio > -1.0 else float("nan")
+
+        pairs = [(k, w) for k, w in ((k, _log1p_or_nan(v)) for k, v in pairs)
+                 if not math.isnan(w)]
 
     user_ids = sorted({u for (u, _), _ in pairs})
     item_ids = sorted({i for (_, i), _ in pairs})

@@ -7,8 +7,8 @@ many random negative items as the user has positives, count how often a
 positive outranks a negative, average over users).
 
 TPU-native: predictions for all test pairs and all sampled negatives are
-two gather+dot kernels; the pairwise positive>negative comparison is a
-padded (U, P, N) broadcast on device instead of a per-user join.
+two batched gather+dot kernels; only the light per-user pairwise
+counting runs on host.
 """
 
 from __future__ import annotations
@@ -29,11 +29,15 @@ def rmse(X: np.ndarray, Y: np.ndarray,
 
 def area_under_curve(X: np.ndarray, Y: np.ndarray,
                      users: np.ndarray, items: np.ndarray) -> float:
-    """Mean per-user AUC over (user, positive-item) test pairs."""
+    """Mean per-user AUC over (user, positive-item) test pairs.
+
+    All positive and all sampled-negative predictions are computed in
+    TWO batched device calls; only the light pairwise counting runs on
+    host per user.
+    """
     if len(users) == 0:
         return 0.0
     rng = RandomManager.random()
-    n_items = Y.shape[0]
     all_items = np.unique(items)
 
     # group positives per user
@@ -42,29 +46,39 @@ def area_under_curve(X: np.ndarray, Y: np.ndarray,
     uniq_users, starts = np.unique(su, return_index=True)
     ends = np.append(starts[1:], len(su))
 
-    aucs = []
-    pos_scores_all = predict_pairs(X, Y, su, si)
+    # sample about as many negatives as positives per user (reference:
+    # with replacement from the distinct item universe, skipping the
+    # user's positives, bounded by the universe size)
+    neg_users: list[int] = []
+    neg_items: list[int] = []
+    neg_bounds = [0]
     for u, lo, hi in zip(uniq_users, starts, ends):
         pos_items = set(si[lo:hi].tolist())
         num_pos = hi - lo
-        # sample about as many negatives as positives (reference samples
-        # with replacement from the distinct item universe, skipping
-        # positives, bounded by the item count)
-        negatives = []
+        negatives: list[int] = []
         for _ in range(len(all_items)):
             if len(negatives) >= num_pos:
                 break
             cand = int(all_items[rng.integers(len(all_items))])
             if cand not in pos_items:
                 negatives.append(cand)
-        if not negatives:
+        neg_users.extend([int(u)] * len(negatives))
+        neg_items.extend(negatives)
+        neg_bounds.append(len(neg_items))
+
+    pos_scores_all = predict_pairs(X, Y, su, si)
+    neg_scores_all = (predict_pairs(
+        X, Y, np.asarray(neg_users, dtype=np.int32),
+        np.asarray(neg_items, dtype=np.int32))
+        if neg_items else np.zeros(0, dtype=np.float32))
+
+    aucs = []
+    for idx, (lo, hi) in enumerate(zip(starts, ends)):
+        neg = neg_scores_all[neg_bounds[idx]:neg_bounds[idx + 1]]
+        if len(neg) == 0:
             aucs.append(0.0)
             continue
-        neg_scores = predict_pairs(
-            X, Y, np.full(len(negatives), u, dtype=np.int32),
-            np.asarray(negatives, dtype=np.int32))
-        pos_scores = pos_scores_all[lo:hi]
-        correct = np.sum(pos_scores[:, None] > neg_scores[None, :])
-        total = num_pos * len(negatives)
-        aucs.append(float(correct) / total if total else 0.0)
+        pos = pos_scores_all[lo:hi]
+        correct = np.sum(pos[:, None] > neg[None, :])
+        aucs.append(float(correct) / (len(pos) * len(neg)))
     return float(np.mean(aucs))

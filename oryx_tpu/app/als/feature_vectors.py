@@ -107,6 +107,11 @@ class FeatureVectorStore:
                 self._dirty.add(row)
                 self._free.append(row)
 
+    def recent_ids(self) -> set[str]:
+        """IDs set since the last retain (reference: FeatureVectors.addAllRecentTo)."""
+        with self._lock.read():
+            return set(self._recent)
+
     def retain_recent_and_ids(self, ids: Iterable[str]) -> None:
         """Drop all IDs not in ``ids`` and not recently set; clear the
         recent set (reference: FeatureVectors.retainRecentAndIDs — the
@@ -144,6 +149,13 @@ class FeatureVectorStore:
 
         Few dirty rows -> one batched scatter; many -> full upload.
         """
+        vecs, active, _ = self.device_arrays_versioned()
+        return vecs, active
+
+    def device_arrays_versioned(self) -> tuple[jax.Array, jax.Array, int]:
+        """Like device_arrays but also returns the snapshot's version,
+        read atomically under the same lock — the safe cache key for
+        derived device state (e.g. LSH buckets)."""
         with self._lock.write():
             cap = len(self._row_to_id)
             if self._device is None or len(self._dirty) >= cap * _FULL_UPLOAD_FRACTION:
@@ -158,7 +170,7 @@ class FeatureVectorStore:
                     jnp.asarray(self._active[rows]))
                 self._device_version += 1
             self._dirty.clear()
-            return self._device, self._device_active
+            return self._device, self._device_active, self._device_version
 
     @property
     def device_version(self) -> int:

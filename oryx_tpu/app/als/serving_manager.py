@@ -87,7 +87,7 @@ class ALSServingModelManager(AbstractServingModelManager):
             x_ids = set(pmml_io.get_extension_content(pmml, "XIDs") or [])
             y_ids = set(pmml_io.get_extension_content(pmml, "YIDs") or [])
             self.model.set_expected_ids(list(x_ids), list(y_ids))
-            self.model.retain_recent_and_known_items(list(x_ids))
+            self.model.retain_recent_and_known_items(list(x_ids), list(y_ids))
             self.model.retain_recent_and_user_ids(list(x_ids))
             self.model.retain_recent_and_item_ids(list(y_ids))
             _log.info("Model updated: %s", self.model)

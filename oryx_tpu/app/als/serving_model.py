@@ -99,11 +99,21 @@ class ALSServingModel(FactorModelBase, ServingModel):
         with self._known_lock.read():
             return {u: len(s) for u, s in self._known_items.items() if s}
 
-    def retain_recent_and_known_items(self, user_ids: Sequence[str]) -> None:
-        keep = set(user_ids)
+    def retain_recent_and_known_items(self, user_ids: Sequence[str],
+                                      item_ids: Sequence[str]) -> None:
+        """Prune known-items on MODEL swap: keep entries for users in the
+        new model or recently updated in X, and within each set keep
+        items in the new model or recently updated in Y
+        (reference: ALSServingModel.retainRecentAndKnownItems :350-383).
+        Must run BEFORE retain_recent_and_user/item_ids, which clear the
+        recent sets."""
+        keep_users = set(user_ids) | self.X.recent_ids()
+        keep_items = set(item_ids) | self.Y.recent_ids()
         with self._known_lock.write():
-            for u in [u for u in self._known_items if u not in keep]:
+            for u in [u for u in self._known_items if u not in keep_users]:
                 del self._known_items[u]
+            for items in self._known_items.values():
+                items &= keep_items
 
     # -- scoring -------------------------------------------------------------
 
@@ -131,8 +141,7 @@ class ALSServingModel(FactorModelBase, ServingModel):
         reference's DotsFunction) or ``cosine_to`` (mean-cosine scores,
         CosineAverageFunction) selects the kernel.
         """
-        vecs, active = self.Y.device_arrays()
-        version = self.Y.device_version
+        vecs, active, version = self.Y.device_arrays_versioned()
         if user_vector is not None:
             q = np.asarray(user_vector, dtype=np.float32)
             scores = _dot_scores(vecs, jnp.asarray(q))

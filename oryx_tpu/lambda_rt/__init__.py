@@ -1,0 +1,3 @@
+from .batch import BatchLayer  # noqa: F401
+from .serving import ServingLayer  # noqa: F401
+from .speed import SpeedLayer  # noqa: F401

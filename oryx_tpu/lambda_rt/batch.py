@@ -1,0 +1,114 @@
+"""The batch layer: periodic model-rebuild generations over all data.
+
+Reference: framework/oryx-lambda/src/main/java/com/cloudera/oryx/lambda/
+batch/BatchLayer.java:48-206 — per generation-interval: run the user
+update over (new, past) data (BatchUpdateFunction.java:50-171), persist
+the new data (SaveToHDFSFunction), commit offsets (UpdateOffsetsFn),
+TTL-delete old data/models (DeleteOldDataFn).  Where the reference is a
+Spark Streaming job over YARN executors, this is a host-side generation
+loop that hands data to a (JAX-computing) BatchLayerUpdate.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from ..common.config import Config
+from ..common.lang import load_instance
+from ..kafka.api import KeyMessage
+from ..kafka.inproc import InProcTopicProducer, resolve_broker
+from . import data_store
+
+_log = logging.getLogger(__name__)
+
+__all__ = ["BatchLayer"]
+
+
+class BatchLayer:
+    """start()/await_()/close() lifecycle around the generation loop."""
+
+    def __init__(self, config: Config):
+        self.config = config
+        self.id = config.get_optional_string("oryx.id")
+        self.input_broker = config.get_string("oryx.input-topic.broker")
+        self.input_topic = config.get_string("oryx.input-topic.message.topic")
+        self.update_broker = config.get_optional_string("oryx.update-topic.broker")
+        self.update_topic = config.get_optional_string("oryx.update-topic.message.topic")
+        self.generation_interval_sec = config.get_int(
+            "oryx.batch.streaming.generation-interval-sec")
+        self.data_dir = config.get_string("oryx.batch.storage.data-dir")
+        self.model_dir = config.get_string("oryx.batch.storage.model-dir")
+        self.max_age_data_hours = config.get_int(
+            "oryx.batch.storage.max-age-data-hours")
+        self.max_age_model_hours = config.get_int(
+            "oryx.batch.storage.max-age-model-hours")
+        update_class = config.get_string("oryx.batch.update-class")
+        self.update_instance = load_instance(update_class, config)
+        self._group = f"OryxGroup-BatchLayer-{self.id or 'default'}"
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        _log.info("Starting batch layer (generation interval %ds)",
+                  self.generation_interval_sec)
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="BatchLayer")
+        self._thread.start()
+
+    def await_(self) -> None:
+        while self._thread and self._thread.is_alive():
+            self._thread.join(1.0)
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(10.0)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.run_one_generation()
+            except Exception:  # noqa: BLE001 — a generation failure must
+                _log.exception("Generation failed")  # not kill the layer
+            self._stop.wait(self.generation_interval_sec)
+
+    # -- one generation ------------------------------------------------------
+
+    def run_one_generation(self) -> None:
+        """Drain new input, persist it, run the update over (new, past),
+        then commit offsets and apply TTLs — commit ordering gives
+        at-least-once with idempotent overwrite (reference semantics)."""
+        timestamp_ms = int(time.time() * 1000)
+        broker = resolve_broker(self.input_broker)
+        start_offset = broker.get_offset(self._group, self.input_topic)
+        if start_offset is None:
+            start_offset = 0  # first run reads from the beginning
+        end_offset = broker.latest_offset(self.input_topic)
+
+        new_data: list[KeyMessage] = []
+        if end_offset > start_offset:
+            topic = broker._topic(self.input_topic)
+            with topic.cond:  # snapshot exactly the [start, end) slice
+                new_data = [KeyMessage(k, m)
+                            for k, m in topic.log[start_offset:end_offset]]
+
+        past_data = data_store.read_all_data(self.data_dir)
+        data_store.save_generation(self.data_dir, timestamp_ms, new_data)
+
+        producer = None
+        if self.update_broker and self.update_topic:
+            producer = InProcTopicProducer(self.update_broker, self.update_topic)
+        _log.info("Running update at %d: %d new, %d past records",
+                  timestamp_ms, len(new_data), len(past_data))
+        self.update_instance.run_update(timestamp_ms, new_data, past_data,
+                                        self.model_dir, producer)
+        # offsets commit only after the update completed (at-least-once)
+        broker.set_offset(self._group, self.input_topic, end_offset)
+        broker.flush()
+
+        data_store.delete_old_data(self.data_dir, self.max_age_data_hours)
+        data_store.delete_old_models(self.model_dir, self.max_age_model_hours)

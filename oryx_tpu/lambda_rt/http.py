@@ -1,0 +1,273 @@
+"""Minimal HTTP resource framework for the serving layer.
+
+Reference equivalents: the serving runtime hosts JAX-RS resources in
+embedded Tomcat with Jersey (framework/oryx-lambda-serving/.../
+ServingLayer.java:58-339, OryxApplication.java:41-98,
+CSVMessageBodyWriter.java:39, ErrorResource.java:36).  This framework
+provides the same contract surface on the stdlib HTTP server: route
+patterns with path variables (including multi-segment tails), JSON/CSV
+content negotiation, gzip, plain-text error pages, DIGEST auth, and
+read-only gating.
+"""
+
+from __future__ import annotations
+
+import base64
+import gzip
+import hashlib
+import io
+import json
+import re
+import secrets
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, NamedTuple
+
+from ..api.serving import HasCSV, OryxServingException
+
+__all__ = ["Route", "Request", "HttpApp", "json_or_csv"]
+
+
+class Route(NamedTuple):
+    method: str               # GET / POST / DELETE / HEAD
+    pattern: str              # e.g. "/recommend/{userID}", "/similarity/{itemID:+}"
+    handler: Callable[["Request"], Any]
+    mutates: bool = False     # disabled in read-only mode
+
+
+class Request(NamedTuple):
+    method: str
+    path: str
+    params: dict[str, str]        # path variables
+    query: dict[str, list[str]]
+    body: bytes
+    headers: dict[str, str]
+    context: dict[str, Any]       # app-scope objects (model manager, producer...)
+
+    def q1(self, name: str, default: str | None = None) -> str | None:
+        vals = self.query.get(name)
+        return vals[0] if vals else default
+
+    def q_int(self, name: str, default: int) -> int:
+        v = self.q1(name)
+        return default if v is None else int(v)
+
+    def q_list(self, name: str) -> list[str]:
+        return self.query.get(name, [])
+
+
+def _compile(pattern: str) -> re.Pattern:
+    out = []
+    for part in pattern.strip("/").split("/"):
+        if part.startswith("{") and part.endswith("}"):
+            name = part[1:-1]
+            if name.endswith(":+"):
+                out.append(f"(?P<{name[:-2]}>.+)")
+            else:
+                out.append(f"(?P<{name}>[^/]+)")
+        else:
+            out.append(re.escape(part))
+    return re.compile("^/" + "/".join(out) + "$")
+
+
+def json_or_csv(value: Any, accept: str) -> tuple[bytes, str]:
+    """Render a response honoring Accept: JSON by default, CSV lines when
+    text/csv is asked for (reference: CSVMessageBodyWriter)."""
+    wants_csv = "text/csv" in accept or (
+        "text/plain" in accept and "json" not in accept)
+    if wants_csv:
+        if isinstance(value, (list, tuple)):
+            lines = []
+            for item in value:
+                if hasattr(item, "to_csv"):  # HasCSV contract, duck-typed
+                    lines.append(item.to_csv())
+                elif isinstance(item, (list, tuple)):
+                    lines.append(",".join(str(x) for x in item))
+                else:
+                    lines.append(str(item))
+            return ("\n".join(lines) + ("\n" if lines else "")).encode(), \
+                "text/csv"
+        if hasattr(value, "to_csv"):
+            return (value.to_csv() + "\n").encode(), "text/csv"
+        return (str(value) + "\n").encode(), "text/plain"
+    # JSON
+    def _default(o):
+        if hasattr(o, "__dict__"):
+            return o.__dict__
+        raise TypeError(type(o).__name__)
+
+    return json.dumps(value, default=_default).encode(), "application/json"
+
+
+class HttpApp:
+    """Routes + app context, servable by ThreadingHTTPServer."""
+
+    def __init__(self, routes: list[Route], context: dict[str, Any],
+                 read_only: bool = False,
+                 user_name: str | None = None, password: str | None = None,
+                 context_path: str = "/"):
+        self._routes = [(r, _compile(r.pattern)) for r in routes]
+        self.context = context
+        self.read_only = read_only
+        self.user_name = user_name
+        self.password = password
+        self.realm = "Oryx"
+        self.context_path = "" if context_path in ("/", "") else context_path.rstrip("/")
+        self._nonces: set[str] = set()
+        self._nonce_lock = threading.Lock()
+
+    # -- auth (DIGEST, reference: InMemoryRealm + DIGEST auth config) -------
+
+    def _auth_ok(self, handler: BaseHTTPRequestHandler) -> bool:
+        if self.user_name is None:
+            return True
+        auth = handler.headers.get("Authorization", "")
+        if not auth.startswith("Digest "):
+            return False
+        pairs = re.findall(r'(\w+)=(?:"([^"]*)"|([^, ]*))', auth[7:])
+        parts = {k: (quoted or bare) for k, quoted, bare in pairs}
+        nonce = parts.get("nonce", "")
+        with self._nonce_lock:
+            if nonce not in self._nonces:
+                return False
+        if parts.get("username") != self.user_name:
+            return False
+        ha1 = hashlib.md5(
+            f"{self.user_name}:{self.realm}:{self.password}".encode()).hexdigest()
+        ha2 = hashlib.md5(
+            f"{handler.command}:{parts.get('uri', '')}".encode()).hexdigest()
+        if "qop" in parts:
+            expected = hashlib.md5(
+                f"{ha1}:{nonce}:{parts.get('nc','')}:{parts.get('cnonce','')}:"
+                f"{parts.get('qop','')}:{ha2}".encode()).hexdigest()
+        else:
+            expected = hashlib.md5(f"{ha1}:{nonce}:{ha2}".encode()).hexdigest()
+        return secrets.compare_digest(expected, parts.get("response", ""))
+
+    def _challenge(self, handler: BaseHTTPRequestHandler) -> None:
+        nonce = secrets.token_hex(16)
+        with self._nonce_lock:
+            self._nonces.add(nonce)
+            if len(self._nonces) > 10000:
+                self._nonces.clear()
+                self._nonces.add(nonce)
+        handler.send_response(401)
+        handler.send_header(
+            "WWW-Authenticate",
+            f'Digest realm="{self.realm}", nonce="{nonce}", qop="auth"')
+        handler.end_headers()
+
+    # -- dispatch ------------------------------------------------------------
+
+    def handle(self, handler: BaseHTTPRequestHandler) -> None:
+        try:
+            self._handle(handler)
+        except BrokenPipeError:  # client went away
+            pass
+
+    def _handle(self, handler: BaseHTTPRequestHandler) -> None:
+        if not self._auth_ok(handler):
+            self._challenge(handler)
+            return
+        parsed = urllib.parse.urlparse(handler.path)
+        path = urllib.parse.unquote(parsed.path)
+        if self.context_path and path.startswith(self.context_path):
+            path = path[len(self.context_path):] or "/"
+        query = urllib.parse.parse_qs(parsed.query)
+        method = handler.command
+        lookup_method = "GET" if method == "HEAD" else method
+
+        matched_path = False
+        for route, regex in self._routes:
+            m = regex.match(path)
+            if not m:
+                continue
+            matched_path = True
+            if route.method != lookup_method:
+                continue
+            if route.mutates and self.read_only:
+                self._send_error(handler, 403, "endpoint is read-only")
+                return
+            length = int(handler.headers.get("Content-Length") or 0)
+            body = handler.rfile.read(length) if length else b""
+            if handler.headers.get("Content-Encoding", "") == "gzip" and body:
+                body = gzip.decompress(body)
+            req = Request(method, path, m.groupdict(), query, body,
+                          dict(handler.headers), self.context)
+            try:
+                result = route.handler(req)
+            except OryxServingException as e:
+                self._send_error(handler, e.status, str(e))
+                return
+            except (ValueError, KeyError) as e:
+                self._send_error(handler, 400, f"bad request: {e}")
+                return
+            except Exception as e:  # noqa: BLE001 — uniform 500 error page
+                self._send_error(handler, 500, f"{type(e).__name__}: {e}")
+                return
+            self._send(handler, result, method == "HEAD",
+                       handler.headers.get("Accept", ""),
+                       "gzip" in handler.headers.get("Accept-Encoding", ""))
+            return
+        if matched_path:
+            self._send_error(handler, 405, "method not allowed")
+        else:
+            self._send_error(handler, 404, f"no resource at {path}")
+
+    def _send(self, handler, result, head_only: bool, accept: str,
+              gzip_ok: bool) -> None:
+        status = 200
+        if isinstance(result, tuple) and len(result) == 2 \
+                and isinstance(result[0], int):
+            status, result = result
+        if result is None:
+            handler.send_response(status if status != 200 else 204)
+            handler.end_headers()
+            return
+        payload, ctype = json_or_csv(result, accept)
+        handler.send_response(status)
+        handler.send_header("Content-Type", ctype)
+        if gzip_ok and len(payload) > 256:
+            payload = gzip.compress(payload)
+            handler.send_header("Content-Encoding", "gzip")
+        handler.send_header("Content-Length", str(len(payload)))
+        handler.end_headers()
+        if not head_only:
+            handler.wfile.write(payload)
+
+    def _send_error(self, handler, status: int, message: str) -> None:
+        # uniform plain-text error page (reference: ErrorResource.java:36)
+        payload = f"HTTP {status}\n{message}\n".encode()
+        handler.send_response(status)
+        handler.send_header("Content-Type", "text/plain")
+        handler.send_header("Content-Length", str(len(payload)))
+        handler.end_headers()
+        try:
+            handler.wfile.write(payload)
+        except BrokenPipeError:
+            pass
+
+
+def make_server(app: HttpApp, port: int) -> ThreadingHTTPServer:
+    class _Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # quiet
+            pass
+
+        def do_GET(self):
+            app.handle(self)
+
+        def do_HEAD(self):
+            app.handle(self)
+
+        def do_POST(self):
+            app.handle(self)
+
+        def do_DELETE(self):
+            app.handle(self)
+
+    server = ThreadingHTTPServer(("0.0.0.0", port), _Handler)
+    server.daemon_threads = True
+    return server

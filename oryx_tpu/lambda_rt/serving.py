@@ -1,0 +1,142 @@
+"""The serving layer: HTTP API over an in-memory model fed by the update
+topic.
+
+Reference: framework/oryx-lambda-serving/src/main/java/com/cloudera/oryx/
+lambda/serving/ServingLayer.java:58-339 (embedded Tomcat, connector
+options, read-only mode, context wiring), ModelManagerListener.java:63-250
+(input producer, update-topic consumer from offset 0 feeding
+modelManager.consume, app-scope attributes), OryxApplication.java:41-98
+(resource discovery from configured packages).
+"""
+
+from __future__ import annotations
+
+import importlib
+import logging
+import threading
+
+from ..common.config import Config
+from ..common.lang import load_instance, logging_call
+from ..kafka import utils as kafka_utils
+from ..kafka.inproc import InProcTopicProducer, resolve_broker
+from .http import HttpApp, Route, make_server
+
+_log = logging.getLogger(__name__)
+
+__all__ = ["ServingLayer"]
+
+
+class ServingLayer:
+    """start()/await_()/close() around the HTTP server + model consumer."""
+
+    def __init__(self, config: Config, port: int | None = None):
+        self.config = config
+        api = "oryx.serving.api"
+        self.port = port if port is not None else config.get_int(f"{api}.port")
+        self.read_only = config.get_bool(f"{api}.read-only")
+        self.user_name = config.get_optional_string(f"{api}.user-name")
+        self.password = config.get_optional_string(f"{api}.password")
+        self.context_path = config.get_string(f"{api}.context-path")
+        self.input_broker = config.get_optional_string("oryx.input-topic.broker")
+        self.input_topic = config.get_optional_string("oryx.input-topic.message.topic")
+        self.update_broker = config.get_optional_string("oryx.update-topic.broker")
+        self.update_topic = config.get_optional_string("oryx.update-topic.message.topic")
+        self.no_init_topics = config.get_bool("oryx.serving.no-init-topics")
+        self.min_model_load_fraction = config.get_double(
+            "oryx.serving.min-model-load-fraction")
+
+        manager_class = config.get_string("oryx.serving.model-manager-class")
+        self.model_manager = load_instance(manager_class, config)
+
+        self._stop = threading.Event()
+        self._consume_thread: threading.Thread | None = None
+        self._server = None
+        self._server_thread: threading.Thread | None = None
+
+        self.input_producer = None
+        if not self.read_only and self.input_broker and self.input_topic:
+            if not self.no_init_topics:
+                kafka_utils.maybe_create_topic(self.input_broker, self.input_topic)
+            self.input_producer = InProcTopicProducer(self.input_broker,
+                                                      self.input_topic)
+
+        routes = self._discover_routes()
+        self.app = HttpApp(
+            routes,
+            context={
+                "model_manager": self.model_manager,
+                "input_producer": self.input_producer,
+                "config": config,
+                "min_model_load_fraction": self.min_model_load_fraction,
+            },
+            read_only=self.read_only,
+            user_name=self.user_name,
+            password=self.password,
+            context_path=self.context_path,
+        )
+
+    def _discover_routes(self) -> list[Route]:
+        """Load Route lists from the configured resource modules
+        (reference: OryxApplication scanning application-resources
+        packages for @Path classes)."""
+        routes: list[Route] = []
+        from ..serving import framework as framework_resources
+
+        routes.extend(framework_resources.ROUTES)
+        resources = self.config.get_optional_string(
+            "oryx.serving.application-resources")
+        if resources:
+            for module_name in resources.split(","):
+                module = importlib.import_module(module_name.strip())
+                routes.extend(getattr(module, "ROUTES"))
+        return routes
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        if self.update_broker and self.update_topic:
+            if not self.no_init_topics:
+                kafka_utils.maybe_create_topic(self.update_broker,
+                                               self.update_topic)
+            # model state = full update-topic replay from offset 0
+            # (reference: auto.offset.reset=smallest,
+            # ModelManagerListener.java:126)
+            self._consume_thread = threading.Thread(
+                target=logging_call(self._consume_updates, "serving-consume"),
+                daemon=True, name="ServingLayerConsume")
+            self._consume_thread.start()
+        self._server = make_server(self.app, self.port)
+        self.port = self._server.server_address[1]
+        self._server_thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="ServingLayerHTTP")
+        self._server_thread.start()
+        _log.info("Serving layer listening on port %d", self.port)
+
+    def _consume_updates(self) -> None:
+        broker = resolve_broker(self.update_broker)
+        self.model_manager.consume(
+            broker.consume(self.update_topic, from_beginning=True,
+                           stop=self._stop))
+
+    def await_(self) -> None:
+        while self._server_thread and self._server_thread.is_alive():
+            self._server_thread.join(1.0)
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._server:
+            self._server.shutdown()
+        self.model_manager.close()
+        if self.input_producer:
+            self.input_producer.close()
+        for t in (self._consume_thread, self._server_thread):
+            if t:
+                t.join(10.0)
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

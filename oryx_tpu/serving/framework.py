@@ -1,0 +1,48 @@
+"""Framework-level serving resources: readiness + shared helpers.
+
+Reference: app/oryx-app-serving/.../Ready.java:34 (HEAD/GET /ready ->
+200/503 against min-model-load-fraction),
+AbstractOryxResource.java:52-... (model gating, input send).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..api.serving import OryxServingException
+from ..lambda_rt.http import Request, Route
+
+__all__ = ["ROUTES", "get_serving_model", "send_input"]
+
+
+def get_serving_model(req: Request) -> Any:
+    """The current model, or 503 until enough is loaded
+    (reference: AbstractOryxResource.getServingModel :76-96)."""
+    manager = req.context["model_manager"]
+    model = manager.get_model()
+    if model is not None:
+        fraction = model.get_fraction_loaded()
+        if fraction >= req.context["min_model_load_fraction"]:
+            return model
+    raise OryxServingException(503, "Model not available yet")
+
+
+def send_input(req: Request, line: str) -> None:
+    producer = req.context.get("input_producer")
+    if producer is None:
+        raise OryxServingException(403, "no input topic configured")
+    producer.send(None, line)
+
+
+def _ready(req: Request):
+    manager = req.context["model_manager"]
+    model = manager.get_model()
+    if model is not None and (model.get_fraction_loaded()
+                              >= req.context["min_model_load_fraction"]):
+        return None  # 204-ish empty 200
+    raise OryxServingException(503, "Model not available yet")
+
+
+ROUTES = [
+    Route("GET", "/ready", _ready),
+]

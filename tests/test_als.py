@@ -269,8 +269,16 @@ def test_fraction_loaded_and_retain():
     assert model.get_fraction_loaded() == pytest.approx(8 / 10)
     model.add_known_items("u0", ["i1"])
     model.add_known_items("gone", ["i2"])
-    model.retain_recent_and_known_items(["u0"])
+    # clear recency so only the new model's IDs are kept
+    model.X._recent.clear()
+    model.Y._recent.clear()
+    model.retain_recent_and_known_items(["u0"], ["i1", "i3"])
     assert model.get_known_items("gone") == set()
+    assert model.get_known_items("u0") == {"i1"}
+    # items absent from the new model are pruned from surviving sets
+    model.add_known_items("u0", ["i9"])
+    model.Y._recent.clear()
+    model.retain_recent_and_known_items(["u0"], ["i1"])
     assert model.get_known_items("u0") == {"i1"}
 
 

@@ -1,0 +1,186 @@
+"""Full-stack lambda integration: real Batch/Speed/Serving layers over the
+in-process broker (reference analogs: AbstractLambdaIT/AbstractBatchIT/
+AbstractSpeedIT/AbstractServingIT — everything in-process on one host,
+small max message size exercising both MODEL and MODEL-REF paths)."""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from oryx_tpu.common.config import from_dict
+from oryx_tpu.kafka.api import KEY_MODEL, KEY_MODEL_REF
+from oryx_tpu.kafka.inproc import get_broker
+from oryx_tpu.lambda_rt.batch import BatchLayer
+from oryx_tpu.lambda_rt.serving import ServingLayer
+from oryx_tpu.lambda_rt.speed import SpeedLayer
+
+
+def _base_config(tmp_path, broker_name, **extra):
+    overlay = {
+        "oryx.id": "it",
+        "oryx.input-topic.broker": f"memory://{broker_name}",
+        "oryx.input-topic.message.topic": "ItInput",
+        "oryx.update-topic.broker": f"memory://{broker_name}",
+        "oryx.update-topic.message.topic": "ItUpdate",
+        "oryx.batch.update-class": "oryx_tpu.app.als.update.ALSUpdate",
+        "oryx.speed.model-manager-class":
+            "oryx_tpu.app.als.speed.ALSSpeedModelManager",
+        "oryx.serving.model-manager-class":
+            "oryx_tpu.app.als.serving_manager.ALSServingModelManager",
+        "oryx.serving.application-resources": "oryx_tpu.serving.als",
+        "oryx.batch.storage.data-dir": str(tmp_path / "data"),
+        "oryx.batch.storage.model-dir": str(tmp_path / "model"),
+        "oryx.als.iterations": 3,
+        "oryx.als.implicit": True,
+        "oryx.als.hyperparams.features": 3,
+        "oryx.ml.eval.test-fraction": 0.0,
+    }
+    overlay.update(extra)
+    return from_dict(overlay)
+
+
+def _produce_ratings(broker, topic, nu=20, ni=12, seed=5):
+    rng = np.random.default_rng(seed)
+    t = 1_700_000_000_000
+    n = 0
+    for u in range(nu):
+        for i in range(ni):
+            if rng.random() < 0.4:
+                broker.send(topic, None,
+                            f"u{u},i{i},{rng.exponential(1):.2f},{t}")
+                t += 1000
+                n += 1
+    return n
+
+
+def test_batch_then_serving_loop(tmp_path):
+    cfg = _base_config(tmp_path, "it1")
+    broker = get_broker("it1")
+    n = _produce_ratings(broker, "ItInput")
+
+    batch = BatchLayer(cfg)
+    batch.run_one_generation()
+
+    # model + factor rows landed on the update topic
+    msgs = list(broker.consume("ItUpdate", from_beginning=True,
+                               max_idle_sec=0.2))
+    assert msgs[0].key == KEY_MODEL
+    assert len(msgs) > 1
+
+    # data persisted for the next generation; offsets committed
+    gen2_past = __import__(
+        "oryx_tpu.lambda_rt.data_store",
+        fromlist=["read_all_data"]).read_all_data(str(tmp_path / "data"))
+    assert len(gen2_past) == n
+    assert broker.get_offset("OryxGroup-BatchLayer-it", "ItInput") == n
+
+    # a second generation with no new data still rebuilds from past data
+    batch.run_one_generation()
+    msgs2 = list(broker.consume("ItUpdate", from_beginning=True,
+                                max_idle_sec=0.2))
+    assert sum(1 for m in msgs2 if m.key == KEY_MODEL) == 2
+
+    # serving layer replays the topic and answers queries
+    serving = ServingLayer(cfg, port=0)
+    serving.start()
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            model = serving.model_manager.get_model()
+            if model is not None and model.get_fraction_loaded() >= 0.8:
+                break
+            time.sleep(0.05)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{serving.port}/ready", timeout=10) as r:
+            assert r.status in (200, 204)
+        uid = model.all_user_ids()[0]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{serving.port}/recommend/{uid}",
+                timeout=10) as r:
+            recs = json.loads(r.read())
+        assert recs and "id" in recs[0]
+    finally:
+        serving.close()
+
+
+def test_model_ref_path_when_message_too_large(tmp_path):
+    # tiny max-size forces MODEL-REF (reference: AbstractLambdaIT.java:104)
+    cfg = _base_config(tmp_path, "it2",
+                       **{"oryx.update-topic.message.max-size": 1 << 7})
+    broker = get_broker("it2")
+    _produce_ratings(broker, "ItInput", nu=30, ni=20)
+    BatchLayer(cfg).run_one_generation()
+    msgs = list(broker.consume("ItUpdate", from_beginning=True,
+                               max_idle_sec=0.2))
+    assert msgs[0].key == KEY_MODEL_REF
+    # serving can follow the reference to the file
+    serving = ServingLayer(cfg, port=0)
+    serving.start()
+    try:
+        deadline = time.time() + 10
+        model = None
+        while time.time() < deadline:
+            model = serving.model_manager.get_model()
+            if model is not None and model.get_fraction_loaded() >= 0.8:
+                break
+            time.sleep(0.05)
+        assert model is not None and model.user_count() > 0
+    finally:
+        serving.close()
+
+
+def test_speed_layer_micro_batch_loop(tmp_path):
+    cfg = _base_config(tmp_path, "it3")
+    broker = get_broker("it3")
+    _produce_ratings(broker, "ItInput")
+    BatchLayer(cfg).run_one_generation()
+
+    speed = SpeedLayer(cfg)
+    speed.start()
+    try:
+        # wait for the speed model to load via topic replay
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            m = speed.model_manager.model
+            if m is not None and m.get_fraction_loaded() >= 0.8:
+                break
+            time.sleep(0.05)
+        before = broker.latest_offset("ItUpdate")
+        broker.send("ItInput", None, "u0,i1,3.0,1800000000000")
+        broker.send("ItInput", None, "newuser,i2,1.0,1800000000001")
+        speed.run_one_micro_batch()
+        deadline = time.time() + 5
+        ups = []
+        while time.time() < deadline:
+            after = broker.latest_offset("ItUpdate")
+            if after > before:
+                topic = broker._topic("ItUpdate")
+                ups = [m for k, m in topic.log[before:] if k == "UP"]
+                if ups:
+                    break
+            time.sleep(0.05)
+        assert ups, "speed layer produced no UP deltas"
+        parsed = [json.loads(u) for u in ups]
+        assert any(p[0] == "X" and p[1] == "newuser" for p in parsed)
+    finally:
+        speed.close()
+
+
+def test_data_store_ttl(tmp_path):
+    from oryx_tpu.lambda_rt import data_store
+    from oryx_tpu.kafka.api import KeyMessage
+
+    old_ts = int(time.time() * 1000) - 10 * 3_600_000
+    new_ts = int(time.time() * 1000)
+    data_store.save_generation(str(tmp_path), old_ts, [KeyMessage(None, "a")])
+    data_store.save_generation(str(tmp_path), new_ts, [KeyMessage(None, "b")])
+    assert len(data_store.read_all_data(str(tmp_path))) == 2
+    deleted = data_store.delete_old_data(str(tmp_path), 5)
+    assert deleted == 1
+    remaining = data_store.read_all_data(str(tmp_path))
+    assert [km.message for km in remaining] == ["b"]
+    # -1 means keep forever
+    assert data_store.delete_old_data(str(tmp_path), -1) == 0

@@ -1,0 +1,312 @@
+"""Serving layer + ALS endpoint tests over live HTTP (reference analogs:
+AbstractALSServingTest/RecommendTest/SimilarityTest/IngestTest/
+ReadOnlyTest/CompressedResponseTest via the Grizzly test container;
+here the real ServingLayer serves on a loopback port)."""
+
+import gzip
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from oryx_tpu.api.serving import AbstractServingModelManager
+from oryx_tpu.app.als.serving_model import ALSServingModel
+from oryx_tpu.common.config import from_dict
+from oryx_tpu.kafka.inproc import get_broker
+from oryx_tpu.lambda_rt.serving import ServingLayer
+
+FEATURES = 4
+
+
+def _build_test_model() -> ALSServingModel:
+    """Deterministic model from fixed matrices
+    (reference: TestALSModelFactory.java:23)."""
+    rng = np.random.default_rng(123)
+    model = ALSServingModel(FEATURES, implicit=True)
+    X = rng.standard_normal((8, FEATURES)).astype(np.float32) * 0.5
+    Y = rng.standard_normal((12, FEATURES)).astype(np.float32) * 0.5
+    for i in range(8):
+        model.set_user_vector(f"U{i}", X[i])
+    for j in range(12):
+        model.set_item_vector(f"I{j}", Y[j])
+    model.add_known_items("U0", ["I0", "I1"])
+    model.add_known_items("U1", ["I1"])
+    return model
+
+
+class MockALSManager(AbstractServingModelManager):
+    model = None
+
+    def get_model(self):
+        return MockALSManager.model
+
+    def consume_key_message(self, key, message):
+        pass
+
+
+@pytest.fixture(scope="module")
+def server():
+    MockALSManager.model = _build_test_model()
+    cfg = from_dict({
+        "oryx.serving.model-manager-class": "tests.test_serving.MockALSManager",
+        "oryx.serving.application-resources": "oryx_tpu.serving.als",
+        "oryx.input-topic.broker": "memory://serving-test",
+        "oryx.input-topic.message.topic": "TestInput",
+        "oryx.update-topic.broker": None,
+        "oryx.update-topic.message.topic": None,
+    })
+    layer = ServingLayer(cfg, port=0)
+    layer.start()
+    yield layer
+    layer.close()
+
+
+def _get(server, path, accept="application/json", raw=False):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}{path}", headers={"Accept": accept})
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        body = resp.read()
+        if raw:
+            return resp, body
+        return json.loads(body) if "json" in accept else body.decode()
+
+
+def _status_of(server, path, method="GET", data=None, headers=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}{path}", method=method, data=data,
+        headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status
+    except urllib.error.HTTPError as e:
+        return e.code
+
+
+def test_ready(server):
+    assert _status_of(server, "/ready") in (200, 204)
+    assert _status_of(server, "/ready", method="HEAD") in (200, 204)
+
+
+def test_recommend(server):
+    recs = _get(server, "/recommend/U2?howMany=4")
+    assert len(recs) == 4
+    assert all(set(r) == {"id", "value"} for r in recs)
+    scores = [r["value"] for r in recs]
+    assert scores == sorted(scores, reverse=True)
+
+
+def test_recommend_excludes_known_items(server):
+    recs = _get(server, "/recommend/U0?howMany=12")
+    ids = {r["id"] for r in recs}
+    assert "I0" not in ids and "I1" not in ids
+    recs2 = _get(server, "/recommend/U0?howMany=12&considerKnownItems=true")
+    assert len(recs2) == 12
+
+
+def test_recommend_offset_pagination(server):
+    all_recs = _get(server, "/recommend/U2?howMany=6")
+    page2 = _get(server, "/recommend/U2?howMany=3&offset=3")
+    assert [r["id"] for r in page2] == [r["id"] for r in all_recs[3:]]
+
+
+def test_recommend_unknown_user_404(server):
+    assert _status_of(server, "/recommend/nobody") == 404
+
+
+def test_recommend_bad_params_400(server):
+    assert _status_of(server, "/recommend/U0?howMany=-1") == 400
+
+
+def test_recommend_csv(server):
+    text = _get(server, "/recommend/U2?howMany=3", accept="text/csv")
+    lines = [l for l in text.splitlines() if l]
+    assert len(lines) == 3
+    assert all(len(l.split(",")) == 2 for l in lines)
+
+
+def test_recommend_to_many(server):
+    recs = _get(server, "/recommendToMany/U2/U3?howMany=5")
+    assert len(recs) == 5
+
+
+def test_recommend_to_anonymous(server):
+    recs = _get(server, "/recommendToAnonymous/I2=2.0/I5?howMany=5")
+    assert len(recs) == 5
+    assert "I2" not in {r["id"] for r in recs}  # context items excluded
+
+
+def test_recommend_with_context(server):
+    recs = _get(server, "/recommendWithContext/U2/I3=1.5?howMany=5")
+    assert len(recs) == 5
+    assert "I3" not in {r["id"] for r in recs}
+
+
+def test_similarity(server):
+    sims = _get(server, "/similarity/I0/I1?howMany=5")
+    assert len(sims) == 5
+    assert {"I0", "I1"}.isdisjoint({s["id"] for s in sims})
+
+
+def test_similarity_to_item(server):
+    sims = _get(server, "/similarityToItem/I0/I1/I2")
+    assert [s["id"] for s in sims] == ["I1", "I2"]
+    # self-similarity is exactly 1
+    self_sim = _get(server, "/similarityToItem/I0/I0")
+    assert self_sim[0]["value"] == pytest.approx(1.0, abs=1e-5)
+
+
+def test_estimate(server):
+    model = MockALSManager.model
+    ests = _get(server, "/estimate/U1/I2/I3")
+    want2 = float(model.get_user_vector("U1") @ model.get_item_vector("I2"))
+    assert ests[0]["value"] == pytest.approx(want2, rel=1e-5)
+    # unknown item estimates 0 (reference behavior)
+    est0 = _get(server, "/estimate/U1/nosuch")
+    assert est0[0]["value"] == 0.0
+
+
+def test_estimate_for_anonymous(server):
+    v = _get(server, "/estimateForAnonymous/I0/I1=2.0/I2")
+    assert isinstance(v, float)
+
+
+def test_because(server):
+    vals = _get(server, "/because/U0/I5")
+    ids = {v["id"] for v in vals}
+    assert ids <= {"I0", "I1"}  # only known items explain
+
+
+def test_most_surprising(server):
+    vals = _get(server, "/mostSurprising/U0")
+    assert len(vals) == 2
+    assert vals[0]["value"] <= vals[1]["value"]  # ascending dot
+
+
+def test_known_items(server):
+    assert _get(server, "/knownItems/U0") == ["I0", "I1"]
+
+
+def test_most_active_users_and_popular_items(server):
+    active = _get(server, "/mostActiveUsers")
+    assert active[0] == {"id": "U0", "count": 2}
+    popular = _get(server, "/mostPopularItems")
+    assert popular[0] == {"id": "I1", "count": 2}
+
+
+def test_popular_representative_items(server):
+    items = _get(server, "/popularRepresentativeItems")
+    assert len(items) == FEATURES
+
+
+def test_all_ids(server):
+    assert sorted(_get(server, "/allUserIDs")) == [f"U{i}" for i in range(8)]
+    assert len(_get(server, "/allItemIDs")) == 12
+
+
+def test_pref_post_and_delete_write_input(server):
+    broker = get_broker("serving-test")
+    start = broker.latest_offset("TestInput")
+    assert _status_of(server, "/pref/U0/I7", method="POST",
+                      data=b"3.5") in (200, 204)
+    assert _status_of(server, "/pref/U0/I7", method="DELETE") in (200, 204)
+    topic = broker._topic("TestInput")
+    new = [m for _, m in topic.log[start:]]
+    assert new == ["U0,I7,3.5", "U0,I7,"]
+
+
+def test_ingest_plain_and_gzip(server):
+    broker = get_broker("serving-test")
+    start = broker.latest_offset("TestInput")
+    body = b"U1,I2,1\nU1,I3,2.0\n"
+    st = _status_of(server, "/ingest", method="POST", data=body)
+    assert st == 200
+    gz = gzip.compress(b"U4,I5,1\n")
+    st2 = _status_of(server, "/ingest", method="POST", data=gz,
+                     headers={"Content-Type": "application/gzip"})
+    assert st2 == 200
+    topic = broker._topic("TestInput")
+    assert [m for _, m in topic.log[start:]] == \
+        ["U1,I2,1", "U1,I3,2.0", "U4,I5,1"]
+
+
+def test_gzip_response(server):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}/allItemIDs",
+        headers={"Accept": "application/json", "Accept-Encoding": "gzip"})
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        body = resp.read()
+        if resp.headers.get("Content-Encoding") == "gzip":
+            body = gzip.decompress(body)
+    assert len(json.loads(body)) == 12
+
+
+def test_404_unknown_path(server):
+    assert _status_of(server, "/nosuchendpoint") == 404
+
+
+def test_503_when_model_not_loaded():
+    MockALSManager.model = None
+    cfg = from_dict({
+        "oryx.serving.model-manager-class": "tests.test_serving.MockALSManager",
+        "oryx.serving.application-resources": "oryx_tpu.serving.als",
+        "oryx.input-topic.broker": "memory://serving-test-2",
+        "oryx.update-topic.broker": None,
+        "oryx.update-topic.message.topic": None,
+    })
+    layer = ServingLayer(cfg, port=0)
+    layer.start()
+    try:
+        assert _status_of(layer, "/ready") == 503
+        assert _status_of(layer, "/recommend/U0") == 503
+    finally:
+        layer.close()
+        MockALSManager.model = _build_test_model()
+
+
+def test_read_only_forbids_mutations():
+    MockALSManager.model = _build_test_model()
+    cfg = from_dict({
+        "oryx.serving.model-manager-class": "tests.test_serving.MockALSManager",
+        "oryx.serving.application-resources": "oryx_tpu.serving.als",
+        "oryx.serving.api.read-only": True,
+        "oryx.update-topic.broker": None,
+        "oryx.update-topic.message.topic": None,
+    })
+    layer = ServingLayer(cfg, port=0)
+    layer.start()
+    try:
+        assert _status_of(layer, "/pref/U0/I1", method="POST", data=b"1") == 403
+        assert _status_of(layer, "/recommend/U0") == 200  # reads still fine
+    finally:
+        layer.close()
+
+
+def test_digest_auth():
+    MockALSManager.model = _build_test_model()
+    cfg = from_dict({
+        "oryx.serving.model-manager-class": "tests.test_serving.MockALSManager",
+        "oryx.serving.application-resources": "oryx_tpu.serving.als",
+        "oryx.serving.api.user-name": "oryx",
+        "oryx.serving.api.password": "pass",
+        "oryx.input-topic.broker": "memory://serving-test-auth",
+        "oryx.update-topic.broker": None,
+        "oryx.update-topic.message.topic": None,
+    })
+    layer = ServingLayer(cfg, port=0)
+    layer.start()
+    try:
+        # unauthenticated -> 401 challenge
+        assert _status_of(layer, "/allUserIDs") == 401
+        # authenticated via urllib's digest handler -> 200
+        mgr = urllib.request.HTTPPasswordMgrWithDefaultRealm()
+        mgr.add_password(None, f"http://127.0.0.1:{layer.port}/",
+                         "oryx", "pass")
+        opener = urllib.request.build_opener(
+            urllib.request.HTTPDigestAuthHandler(mgr))
+        with opener.open(f"http://127.0.0.1:{layer.port}/allUserIDs",
+                         timeout=10) as resp:
+            assert resp.status == 200
+    finally:
+        layer.close()
