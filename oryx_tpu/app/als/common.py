@@ -40,13 +40,18 @@ class ParsedRatings(NamedTuple):
     values: np.ndarray            # (nnz,) float32 aggregated strengths
 
 
+def parse_timestamp(tokens: list[str]) -> int:
+    """Timestamp from the optional 4th input field (reference:
+    MLFunctions.TO_TIMESTAMP_FN); 0 when absent/empty."""
+    return int(float(tokens[3])) if len(tokens) > 3 and tokens[3] != "" else 0
+
+
 def _parse_line(line: str) -> tuple[str, str, float, int]:
     tokens = text_utils.parse_input_line(line)
     user, item = tokens[0], tokens[1]
     # empty strength means 'delete'; propagate as NaN
     value = float("nan") if tokens[2] == "" else float(tokens[2])
-    ts = int(float(tokens[3])) if len(tokens) > 3 and tokens[3] != "" else 0
-    return user, item, value, ts
+    return user, item, value, parse_timestamp(tokens)
 
 
 def decay_value(value: float, timestamp_ms: int, now_ms: int,

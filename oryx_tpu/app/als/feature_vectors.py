@@ -97,6 +97,33 @@ class FeatureVectorStore:
             self._dirty.add(row)
             self._recent.add(id_)
 
+    def bulk_load(self, ids: list[str], matrix: np.ndarray) -> None:
+        """Set many vectors at once — the fast path for MODEL publish
+        consumption and benchmark model factories.  Equivalent to
+        set_vector per row but one vectorized host write instead of n
+        dict/array operations."""
+        matrix = np.asarray(matrix, dtype=np.float32)
+        if matrix.shape != (len(ids), self.features):
+            raise ValueError(
+                f"matrix must be ({len(ids)}, {self.features}), "
+                f"got {matrix.shape}")
+        with self._lock.write():
+            new_ids = [i for i in ids if i not in self._id_to_row]
+            while len(self._free) < len(new_ids):
+                self._grow()
+            rows = np.empty(len(ids), dtype=np.int64)
+            for j, id_ in enumerate(ids):
+                row = self._id_to_row.get(id_)
+                if row is None:
+                    row = self._free.pop()
+                    self._id_to_row[id_] = row
+                    self._row_to_id[row] = id_
+                rows[j] = row
+            self._host[rows] = matrix
+            self._active[rows] = True
+            self._dirty.update(rows.tolist())
+            self._recent.update(ids)
+
     def remove(self, id_: str) -> None:
         with self._lock.write():
             row = self._id_to_row.pop(id_, None)
@@ -179,6 +206,12 @@ class FeatureVectorStore:
         array, which CPython can reuse after free)."""
         with self._lock.read():
             return self._device_version
+
+    def row_ids(self) -> list[str | None]:
+        """Snapshot of the row -> id table (one lock acquisition, for
+        batched result decoding)."""
+        with self._lock.read():
+            return list(self._row_to_id)
 
     def host_arrays(self) -> tuple[np.ndarray, np.ndarray, list[str | None]]:
         """Copy of (vectors, active, row->id) for host-side iteration."""

@@ -65,6 +65,17 @@ def _cosine_mean_scores(Y, V):
 
 
 @partial(jax.jit, static_argnames=("k",))
+def _batch_top_n_kernel(Y, Q, active, k: int):
+    """Score a whole request batch in one device call: (B,k)·(N,k)^T ->
+    masked top-k per row.  This is the serving-time request batcher's
+    kernel (SURVEY §2.14 P6: Tomcat's 400-thread fan-out becomes one
+    MXU matmul over the batched queries)."""
+    scores = jnp.matmul(Q, Y.T, preferred_element_type=jnp.float32)
+    scores = jnp.where(active[None, :], scores, -jnp.inf)
+    return jax.lax.top_k(scores, k)
+
+
+@partial(jax.jit, static_argnames=("k",))
 def _masked_top_k(scores, mask, k: int):
     masked = jnp.where(mask, scores, -jnp.inf)
     return jax.lax.top_k(masked, k)
@@ -163,9 +174,7 @@ class ALSServingModel(FactorModelBase, ServingModel):
                                     lowest)
         # pull a padded window to absorb excluded ids, then host-filter
         k = min(_pad_k(how_many + len(exclude)), int(vecs.shape[0]))
-        top_scores, top_idx = _masked_top_k(scores, mask, k)
-        top_scores = np.asarray(top_scores)
-        top_idx = np.asarray(top_idx)
+        top_scores, top_idx = jax.device_get(_masked_top_k(scores, mask, k))
         out: list[tuple[str, float]] = []
         for s, i in zip(top_scores, top_idx):
             if not math.isfinite(s):
@@ -181,6 +190,41 @@ class ALSServingModel(FactorModelBase, ServingModel):
             return self._host_top_n(np.asarray(scores), np.asarray(mask),
                                     how_many, exclude, None, None, lowest)
         return out
+
+    def top_n_batch(self, how_many: int, user_vectors: np.ndarray,
+                    exclude: Sequence[Iterable[str]] | None = None
+                    ) -> list[list[tuple[str, float]]]:
+        """Batched exact top-N: one device dispatch for a whole batch of
+        /recommend requests.  ``user_vectors`` is (B, features);
+        ``exclude`` optionally gives per-request excluded item IDs.
+        Rescorers/allowed-predicates take the single-request path."""
+        Q = np.asarray(user_vectors, dtype=np.float32)
+        if Q.ndim != 2 or Q.shape[1] != self.features:
+            raise ValueError("user_vectors must be (B, features)")
+        excl = [set(e) for e in exclude] if exclude is not None \
+            else [set()] * Q.shape[0]
+        vecs, active, _ = self.Y.device_arrays_versioned()
+        max_excl = max((len(e) for e in excl), default=0)
+        k = min(_pad_k(how_many + max_excl), int(vecs.shape[0]))
+        # fetch both outputs in ONE host round-trip (matters when the
+        # device sits behind a high-latency transport)
+        top_scores, top_idx = jax.device_get(
+            _batch_top_n_kernel(vecs, jnp.asarray(Q), active, k))
+        row_ids = self.Y.row_ids()
+        results: list[list[tuple[str, float]]] = []
+        for b in range(Q.shape[0]):
+            out: list[tuple[str, float]] = []
+            for s, i in zip(top_scores[b].tolist(), top_idx[b].tolist()):
+                if not math.isfinite(s):
+                    break
+                id_ = row_ids[i]
+                if id_ is None or id_ in excl[b]:
+                    continue
+                out.append((id_, s))
+                if len(out) == how_many:
+                    break
+            results.append(out)
+        return results
 
     def _host_top_n(self, scores: np.ndarray, mask: np.ndarray,
                     how_many: int, exclude: set[str],

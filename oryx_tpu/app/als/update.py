@@ -217,8 +217,8 @@ class ALSUpdate(MLUpdate):
         timestamp range trains, the most recent tail tests
         (reference: splitNewDataToTrainTest :326-343)."""
         def ts(km: KeyMessage) -> int:
-            fields = text_utils.parse_input_line(km.message)
-            return int(float(fields[3])) if len(fields) > 3 and fields[3] else 0
+            return als_common.parse_timestamp(
+                text_utils.parse_input_line(km.message))
 
         stamps = [ts(km) for km in new_data]
         min_t, max_t = min(stamps), max(stamps)

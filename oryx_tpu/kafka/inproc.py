@@ -181,6 +181,15 @@ class InProcBroker:
     def latest_offset(self, topic: str) -> int:
         return self._topic(topic).latest_offset()
 
+    def read_range(self, topic: str, start: int, end: int) -> list[KeyMessage]:
+        """Snapshot of the [start, end) offset slice — the public read
+        path for micro-batch drains (batch/speed layers)."""
+        if end <= start:
+            return []
+        t = self._topic(topic)
+        with t.cond:
+            return [KeyMessage(k, m) for k, m in t.log[start:end]]
+
     def consume(self, topic: str, group: str | None = None,
                 from_beginning: bool = False,
                 poll_timeout_sec: float = 0.1,

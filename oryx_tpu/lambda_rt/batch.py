@@ -89,23 +89,24 @@ class BatchLayer:
             start_offset = 0  # first run reads from the beginning
         end_offset = broker.latest_offset(self.input_topic)
 
-        new_data: list[KeyMessage] = []
-        if end_offset > start_offset:
-            topic = broker._topic(self.input_topic)
-            with topic.cond:  # snapshot exactly the [start, end) slice
-                new_data = [KeyMessage(k, m)
-                            for k, m in topic.log[start_offset:end_offset]]
+        new_data: list[KeyMessage] = broker.read_range(
+            self.input_topic, start_offset, end_offset)
 
         past_data = data_store.read_all_data(self.data_dir)
-        data_store.save_generation(self.data_dir, timestamp_ms, new_data)
 
         producer = None
         if self.update_broker and self.update_topic:
             producer = InProcTopicProducer(self.update_broker, self.update_topic)
         _log.info("Running update at %d: %d new, %d past records",
                   timestamp_ms, len(new_data), len(past_data))
+        # update runs BEFORE the generation is persisted (reference output
+        # op order: BatchUpdateFunction then SaveToHDFSFunction,
+        # BatchLayer.java:111-130); a failed update therefore leaves
+        # neither a data file nor committed offsets, so the retry sees
+        # exactly the same (new, past) split instead of duplicated input
         self.update_instance.run_update(timestamp_ms, new_data, past_data,
                                         self.model_dir, producer)
+        data_store.save_generation(self.data_dir, timestamp_ms, new_data)
         # offsets commit only after the update completed (at-least-once)
         broker.set_offset(self._group, self.input_topic, end_offset)
         broker.flush()

@@ -156,6 +156,8 @@ class HttpApp:
         handler.send_header(
             "WWW-Authenticate",
             f'Digest realm="{self.realm}", nonce="{nonce}", qop="auth"')
+        # keep-alive clients block on a close-delimited body without this
+        handler.send_header("Content-Length", "0")
         handler.end_headers()
 
     # -- dispatch ------------------------------------------------------------

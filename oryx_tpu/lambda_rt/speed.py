@@ -82,9 +82,7 @@ class SpeedLayer:
             end = broker.latest_offset(self.input_topic)
             if end <= pos:
                 continue
-            topic = broker._topic(self.input_topic)
-            with topic.cond:
-                new_data = [KeyMessage(k, m) for k, m in topic.log[pos:end]]
+            new_data = broker.read_range(self.input_topic, pos, end)
             try:
                 updates = self.model_manager.build_updates(new_data)
                 for update in updates:
@@ -102,9 +100,7 @@ class SpeedLayer:
         end = broker.latest_offset(self.input_topic)
         if end <= pos:
             return
-        topic = broker._topic(self.input_topic)
-        with topic.cond:
-            new_data = [KeyMessage(k, m) for k, m in topic.log[pos:end]]
+        new_data = broker.read_range(self.input_topic, pos, end)
         for update in self.model_manager.build_updates(new_data):
             self._producer.send(KEY_UP, update)
         broker.set_offset(self._group, self.input_topic, end)

@@ -1,0 +1,26 @@
+"""Multi-device parallelism: mesh construction and the distributed ALS
+trainer (shard_map over ICI with XLA collectives).
+
+This is the TPU-native replacement for the reference's cluster-scale
+training path (Spark MLlib ALS block partitioning,
+app/oryx-app-mllib/.../als/ALSUpdate.java:141-152) and its Spark
+driver/executor communication backend (SURVEY §5.8): shuffles become
+all_gather/psum over the device mesh.
+"""
+
+from .mesh import build_mesh, local_mesh
+from .als_dist import (
+    BlockedRatings,
+    block_ratings,
+    make_train_step,
+    train_als_distributed,
+)
+
+__all__ = [
+    "build_mesh",
+    "local_mesh",
+    "BlockedRatings",
+    "block_ratings",
+    "make_train_step",
+    "train_als_distributed",
+]

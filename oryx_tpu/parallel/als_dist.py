@@ -1,0 +1,166 @@
+"""Distributed ALS: one jitted training step over a device mesh.
+
+Reference counterpart: Spark MLlib's block-partitioned ALS, invoked at
+app/oryx-app-mllib/.../als/ALSUpdate.java:141-152, where users x items
+blocks are shuffled between executors each half-sweep.
+
+TPU-native redesign (NOT a block-shuffle translation):
+ - both factor matrices are ROW-SHARDED over the mesh axis "d"
+   (X: users/d, Y: items/d) and live in HBM;
+ - interactions are pre-blocked on host into a dense padded per-row
+   layout (cols/vals/mask of shape (rows, P)), row-sharded the same way,
+   so every device solves the normal equations for its own row block
+   with ONE batched MXU matmul — static shapes, no per-row loop;
+ - per half-sweep the opposite factor is all-gathered over ICI
+   (lax.all_gather) and its Gramian is formed by psum of local partial
+   Gramians (lax.psum) — these two collectives replace the Spark
+   shuffle entirely;
+ - the whole two-half-sweep step is a single shard_map-ed jitted
+   program; run it `iterations` times.
+
+This scales the memory of the blocked interaction layout and the solve
+FLOPs linearly with devices; the all-gathered opposite factor is the
+same replicate-the-smaller-side tradeoff MLlib makes with its block
+broadcast.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..app.als.common import ParsedRatings
+from ..app.als.trainer import ALSModel, _solve_batch
+from ..common.rand import RandomManager
+
+__all__ = ["BlockedRatings", "block_ratings", "make_train_step",
+           "train_als_distributed"]
+
+
+class BlockedRatings(NamedTuple):
+    """Dense padded per-row interaction blocks for both half-sweeps.
+
+    Row counts are padded to a multiple of the mesh size; padding rows
+    have all-zero masks and solve to zero-ish vectors that are sliced
+    away at the end.
+    """
+
+    n_users: int          # true (unpadded) user count
+    n_items: int          # true (unpadded) item count
+    u_cols: np.ndarray    # (n_users_pad, Pu) int32 item index per slot
+    u_vals: np.ndarray    # (n_users_pad, Pu) float32
+    u_mask: np.ndarray    # (n_users_pad, Pu) float32 1.0 at real entries
+    i_cols: np.ndarray    # (n_items_pad, Pi) int32 user index per slot
+    i_vals: np.ndarray    # (n_items_pad, Pi) float32
+    i_mask: np.ndarray    # (n_items_pad, Pi) float32
+
+
+def _pad_rows(n: int, n_dev: int) -> int:
+    return max(n_dev, ((n + n_dev - 1) // n_dev) * n_dev)
+
+
+def _dense_block(rows: np.ndarray, cols: np.ndarray, vals: np.ndarray,
+                 n_rows_pad: int) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    order = np.argsort(rows, kind="stable")
+    rows, cols, vals = rows[order], cols[order], vals[order]
+    counts = np.bincount(rows, minlength=n_rows_pad)
+    p = 1 << max(0, int(counts.max(initial=1) - 1).bit_length())
+    bcols = np.zeros((n_rows_pad, p), dtype=np.int32)
+    bvals = np.zeros((n_rows_pad, p), dtype=np.float32)
+    bmask = np.zeros((n_rows_pad, p), dtype=np.float32)
+    slot = np.concatenate([np.arange(c) for c in counts if c > 0]) \
+        if len(rows) else np.zeros(0, np.int64)
+    bcols[rows, slot] = cols
+    bvals[rows, slot] = vals
+    bmask[rows, slot] = 1.0
+    return bcols, bvals, bmask
+
+
+def block_ratings(ratings: ParsedRatings, n_devices: int) -> BlockedRatings:
+    """Build the device-blocked layout from aggregated COO interactions."""
+    n_users = len(ratings.user_ids)
+    n_items = len(ratings.item_ids)
+    nu_pad = _pad_rows(n_users, n_devices)
+    ni_pad = _pad_rows(n_items, n_devices)
+    u_cols, u_vals, u_mask = _dense_block(
+        ratings.users, ratings.items, ratings.values, nu_pad)
+    i_cols, i_vals, i_mask = _dense_block(
+        ratings.items, ratings.users, ratings.values, ni_pad)
+    return BlockedRatings(n_users, n_items,
+                          u_cols, u_vals, u_mask, i_cols, i_vals, i_mask)
+
+
+def make_train_step(mesh: Mesh, lam: float, alpha: float, implicit: bool,
+                    axis: str = "d"):
+    """Build the jitted distributed step: (X, Y, blocks…) -> (X', Y').
+
+    All array arguments are expected sharded with PartitionSpec((axis,))
+    on their leading (row) dimension.
+    """
+
+    def _half(opposite_local, cols, vals, mask):
+        # collectives: gather the opposite factor over ICI; Gramian by
+        # psum of local partials (only needed for the implicit base term
+        # but cheap either way, and it keeps one code path)
+        full = jax.lax.all_gather(opposite_local, axis, axis=0, tiled=True)
+        g_local = jnp.matmul(opposite_local.T, opposite_local,
+                             preferred_element_type=jnp.float32)
+        G = jax.lax.psum(g_local, axis)
+        Yg = full[cols]  # (rows_local, P, k)
+        x = _solve_batch(Yg, vals, mask, G,
+                         jnp.float32(lam), jnp.float32(alpha), implicit)
+        # padding rows (no interactions) can produce a singular system;
+        # pin them to zero so they never poison the next Gramian/gather
+        n = jnp.sum(mask, axis=1)
+        return jnp.where((n > 0.0)[:, None], x, 0.0)
+
+    def _step(X, Y, u_cols, u_vals, u_mask, i_cols, i_vals, i_mask):
+        X = _half(Y, u_cols, u_vals, u_mask)
+        Y = _half(X, i_cols, i_vals, i_mask)
+        return X, Y
+
+    spec = P(axis)
+    sharded = jax.shard_map(
+        _step, mesh=mesh,
+        in_specs=(spec,) * 8,
+        out_specs=(spec, spec))
+    return jax.jit(sharded)
+
+
+def train_als_distributed(ratings: ParsedRatings, features: int, lam: float,
+                          alpha: float, implicit: bool, iterations: int,
+                          mesh: Mesh, seed: int | None = None,
+                          axis: str = "d") -> ALSModel:
+    """Full multi-device ALS training loop; returns host-side factors."""
+    n_dev = mesh.devices.size
+    k = features
+    if len(ratings.user_ids) == 0 or len(ratings.item_ids) == 0:
+        return ALSModel(ratings.user_ids, ratings.item_ids,
+                        np.zeros((0, k), np.float32),
+                        np.zeros((0, k), np.float32))
+    blocks = block_ratings(ratings, n_dev)
+
+    rng = np.random.default_rng(
+        RandomManager.random_seed() if seed is None else seed)
+    Y0 = (rng.standard_normal((blocks.i_cols.shape[0], k))
+          / math.sqrt(k)).astype(np.float32)
+    Y0[blocks.n_items:] = 0.0  # padding rows must not leak into the Gramian
+    X0 = np.zeros((blocks.u_cols.shape[0], k), dtype=np.float32)
+
+    row_sharding = NamedSharding(mesh, P(axis))
+    put = partial(jax.device_put, device=row_sharding)
+    X, Y = put(X0), put(Y0)
+    args = tuple(put(a) for a in (blocks.u_cols, blocks.u_vals, blocks.u_mask,
+                                  blocks.i_cols, blocks.i_vals, blocks.i_mask))
+    step = make_train_step(mesh, lam, alpha, implicit, axis)
+    for _ in range(iterations):
+        X, Y = step(X, Y, *args)
+    Xh = np.asarray(X)[:blocks.n_users]
+    Yh = np.asarray(Y)[:blocks.n_items]
+    return ALSModel(ratings.user_ids, ratings.item_ids, Xh, Yh)

@@ -1,0 +1,36 @@
+"""Device mesh helpers.
+
+The reference scales out through Spark executors on YARN
+(framework/oryx-lambda/.../AbstractSparkLayer.java:137-168 builds the
+streaming context whose tasks fan out over the cluster).  The TPU-native
+analog is a jax.sharding.Mesh over the chips of a slice: data-parallel
+rows of the factor matrices ride the "d" axis, and cross-device
+communication is XLA collectives over ICI instead of Spark shuffle.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+__all__ = ["build_mesh", "local_mesh"]
+
+
+def build_mesh(n_devices: int | None = None, axis: str = "d") -> Mesh:
+    """A 1-D mesh over the first ``n_devices`` visible devices (all, if
+    None).  One axis is the right shape for ALS: both factor matrices are
+    row-sharded over it and the opposite factor is all-gathered per
+    half-sweep, so a single axis carries all collective traffic."""
+    devs = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devs):
+            raise ValueError(
+                f"requested {n_devices} devices but only {len(devs)} visible")
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), (axis,))
+
+
+def local_mesh(axis: str = "d") -> Mesh:
+    """Mesh over every device JAX can see (single-host: all local chips)."""
+    return build_mesh(None, axis)

@@ -387,17 +387,16 @@ def _ingest(req: Request):
         text = "\n".join(texts)
     else:
         text = body.decode()
-    count = 0
-    for line in text.splitlines():
-        line = line.strip()
-        if not line:
-            continue
+    # validate the whole (already fully buffered) body before sending
+    # anything, so a bad line can't leave a partial ingest behind
+    lines = [ln.strip() for ln in text.splitlines() if ln.strip()]
+    for line in lines:
         fields = text_utils.parse_input_line(line)
         if not 2 <= len(fields) <= 4:
             raise OryxServingException(400, f"bad line: {line}")
+    for line in lines:
         send_input(req, line)
-        count += 1
-    return {"ingested": count}
+    return {"ingested": len(lines)}
 
 
 ROUTES = [

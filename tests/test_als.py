@@ -503,3 +503,52 @@ def test_serving_manager_full_replay(tmp_path):
     # known items were delivered with X updates
     counts = model.get_known_item_counts()
     assert counts and all(v > 0 for v in counts.values())
+
+
+# -- batched serving scan + bulk load ----------------------------------------
+
+def test_top_n_batch_matches_single():
+    from oryx_tpu.app.als.serving_model import ALSServingModel
+    rng = np.random.default_rng(9)
+    model = ALSServingModel(features=5, implicit=True)
+    ids = [f"I{j}" for j in range(40)]
+    Y = rng.standard_normal((40, 5)).astype(np.float32)
+    model.Y.bulk_load(ids, Y)
+    Q = rng.standard_normal((6, 5)).astype(np.float32)
+    batch = model.top_n_batch(4, Q)
+    assert len(batch) == 6
+    for b in range(6):
+        single = model.top_n(4, user_vector=Q[b])
+        assert [i for i, _ in batch[b]] == [i for i, _ in single]
+        np.testing.assert_allclose([s for _, s in batch[b]],
+                                   [s for _, s in single], rtol=1e-5)
+
+
+def test_top_n_batch_respects_exclusions():
+    from oryx_tpu.app.als.serving_model import ALSServingModel
+    rng = np.random.default_rng(10)
+    model = ALSServingModel(features=3, implicit=True)
+    ids = [f"I{j}" for j in range(10)]
+    model.Y.bulk_load(ids, rng.standard_normal((10, 3)).astype(np.float32))
+    q = rng.standard_normal((1, 3)).astype(np.float32)
+    full = model.top_n_batch(3, q)[0]
+    excluded = model.top_n_batch(3, q, exclude=[{full[0][0]}])[0]
+    assert full[0][0] not in [i for i, _ in excluded]
+    assert len(excluded) == 3
+
+
+def test_bulk_load_overwrites_and_grows():
+    from oryx_tpu.app.als.feature_vectors import FeatureVectorStore
+    store = FeatureVectorStore(4, initial_capacity=16)
+    rng = np.random.default_rng(11)
+    ids = [f"x{j}" for j in range(100)]
+    M = rng.standard_normal((100, 4)).astype(np.float32)
+    store.bulk_load(ids, M)
+    assert len(store) == 100
+    np.testing.assert_array_equal(store.get_vector("x7"), M[7])
+    M2 = rng.standard_normal((100, 4)).astype(np.float32)
+    store.bulk_load(ids, M2)
+    assert len(store) == 100
+    np.testing.assert_array_equal(store.get_vector("x7"), M2[7])
+    vecs, active = store.device_arrays()
+    assert int(np.asarray(active).sum()) == 100
