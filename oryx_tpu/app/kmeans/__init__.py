@@ -1,0 +1,8 @@
+"""k-means clustering app family: trainer, evals, speed, serving.
+
+Reference inventory (SURVEY §2.8/2.9/2.10/2.11 k-means rows):
+ClusterInfo/KMeansUtils/KMeansPMMLUtils (app-common), KMeansUpdate +
+four eval indices (app-mllib), KMeansSpeedModel(+Manager) (app),
+KMeansServingModel(+Manager) + /assign,/add,/distanceToNearest
+endpoints (app-serving).
+"""

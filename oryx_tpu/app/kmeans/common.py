@@ -1,0 +1,114 @@
+"""Shared k-means domain logic.
+
+Reference: app/oryx-app-common/src/main/java/com/cloudera/oryx/app/
+kmeans/ClusterInfo.java:26 (center/count with moving-average online
+update), KMeansUtils.java:29 (closestCluster linear scan,
+featuresFromTokens), EuclideanDistanceFn.java.
+
+TPU-native note: the per-point linear scan over clusters becomes a
+single (n_points, k_clusters) distance matmul-argmin kernel
+(assign_points); ClusterInfo stays a host value type because cluster
+counts are tiny.
+"""
+
+from __future__ import annotations
+
+import threading
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..schema import InputSchema
+
+__all__ = ["ClusterInfo", "closest_cluster", "assign_points",
+           "features_from_tokens", "parse_to_matrix"]
+
+
+class ClusterInfo:
+    """One cluster's center and observed count, with the reference's
+    moving-average update: c' = c + (n_new/(n+n_new)) * (p - c)."""
+
+    def __init__(self, id_: int, center, count: int):
+        center = np.asarray(center, dtype=np.float64)
+        if center.size == 0:
+            raise ValueError("empty center")
+        if count < 1:
+            raise ValueError("count must be >= 1")
+        self.id = id_
+        self.center = center
+        self.count = int(count)
+        self._lock = threading.Lock()
+
+    def update(self, new_point, new_count: int) -> None:
+        new_point = np.asarray(new_point, dtype=np.float64)
+        with self._lock:
+            total = self.count + new_count
+            self.center = self.center + (new_count / total) * (new_point
+                                                               - self.center)
+            self.count = total
+
+    def __repr__(self):
+        return f"{self.id} {self.center.tolist()} {self.count}"
+
+
+@partial(jax.jit, static_argnames=())
+def _assign_kernel(points, centers):
+    # squared euclidean via ||p||^2 - 2 p.c + ||c||^2; argmin over centers
+    d = (jnp.sum(points * points, axis=1, keepdims=True)
+         - 2.0 * jnp.matmul(points, centers.T,
+                            preferred_element_type=jnp.float32)
+         + jnp.sum(centers * centers, axis=1)[None, :])
+    d = jnp.maximum(d, 0.0)
+    idx = jnp.argmin(d, axis=1)
+    return idx, jnp.sqrt(jnp.min(d, axis=1))
+
+
+def assign_points(points: np.ndarray, centers: np.ndarray
+                  ) -> tuple[np.ndarray, np.ndarray]:
+    """(cluster_index, euclidean_distance) for every point — the batch
+    form of the reference's per-point closestCluster scan."""
+    idx, dist = jax.device_get(_assign_kernel(
+        jnp.asarray(points, dtype=jnp.float32),
+        jnp.asarray(centers, dtype=jnp.float32)))
+    return idx, dist
+
+
+def closest_cluster(clusters: list[ClusterInfo],
+                    vector) -> tuple[ClusterInfo, float]:
+    """Reference KMeansUtils.closestCluster: nearest by euclidean
+    distance.  Host scan — cluster counts are small and this sits on
+    single-datum request paths."""
+    if not clusters:
+        raise ValueError("no clusters")
+    vec = np.asarray(vector, dtype=np.float64)
+    best, best_d = None, float("inf")
+    for c in clusters:
+        d = float(np.linalg.norm(c.center - vec))
+        if d < best_d:
+            best, best_d = c, d
+    if not np.isfinite(best_d):
+        raise ValueError("non-finite distance")
+    return best, best_d
+
+
+def features_from_tokens(tokens: list[str],
+                         schema: InputSchema) -> np.ndarray:
+    """Numeric predictor vector from a tokenized input line
+    (reference: KMeansUtils.featuresFromTokens)."""
+    out = np.zeros(schema.num_predictors, dtype=np.float64)
+    for f in range(len(tokens)):
+        if schema.is_active(f):
+            out[schema.feature_to_predictor_index(f)] = float(tokens[f])
+    return out
+
+
+def parse_to_matrix(lines: list[list[str]],
+                    schema: InputSchema) -> np.ndarray:
+    """(n, num_predictors) float32 matrix from tokenized lines."""
+    n = len(lines)
+    out = np.zeros((n, schema.num_predictors), dtype=np.float32)
+    for i, tokens in enumerate(lines):
+        out[i] = features_from_tokens(tokens, schema)
+    return out

@@ -2,22 +2,120 @@
 
 Reference: app/oryx-app-common/src/main/java/com/cloudera/oryx/app/pmml/
 AppPMMLUtils.java — readPMMLFromUpdateKeyMessage :259 (MODEL = inline
-XML; MODEL-REF = storage path, missing file tolerated with a warning).
+XML; MODEL-REF = storage path, missing file tolerated with a warning),
+buildMiningSchema :131, buildDataDictionary :198, toArray :116.
 """
 
 from __future__ import annotations
 
 import logging
 import os
+import xml.etree.ElementTree as ET
 from xml.etree.ElementTree import Element
 
 from ..common import pmml as pmml_io
+from ..common import text as text_utils
 from ..common.io_utils import strip_scheme
 from ..kafka.api import KEY_MODEL, KEY_MODEL_REF
+from .schema import CategoricalValueEncodings, InputSchema
 
 _log = logging.getLogger(__name__)
 
-__all__ = ["read_pmml_from_update_key_message"]
+__all__ = [
+    "read_pmml_from_update_key_message", "build_mining_schema",
+    "build_data_dictionary", "get_feature_names", "find_target_index",
+    "build_categorical_value_encodings", "to_pmml_array",
+]
+
+_q = pmml_io._q
+
+
+def build_mining_schema(schema: InputSchema,
+                        importances=None) -> Element:
+    """MiningSchema element from an InputSchema (reference:
+    AppPMMLUtils.buildMiningSchema :131): numeric/categorical actives
+    get continuous/categorical optypes, id/ignored are supplementary,
+    the target is predicted; importances (per-predictor) optional."""
+    if importances is not None and \
+            len(importances) != schema.num_predictors:
+        raise ValueError("importances must match predictor count")
+    ms = ET.Element(_q("MiningSchema"))
+    for f, name in enumerate(schema.feature_names):
+        attrs = {"name": name}
+        if schema.is_numeric(name):
+            attrs["optype"] = "continuous"
+            attrs["usageType"] = "active"
+        elif schema.is_categorical(name):
+            attrs["optype"] = "categorical"
+            attrs["usageType"] = "active"
+        else:
+            attrs["usageType"] = "supplementary"
+        if schema.has_target() and schema.is_target(name):
+            attrs["usageType"] = "predicted"
+        if attrs["usageType"] == "active" and importances is not None:
+            attrs["importance"] = text_utils._render(
+                float(importances[schema.feature_to_predictor_index(f)]))
+        ET.SubElement(ms, _q("MiningField"), attrs)
+    return ms
+
+
+def build_data_dictionary(
+        schema: InputSchema,
+        encodings: CategoricalValueEncodings | None) -> Element:
+    """DataDictionary element (reference: buildDataDictionary :198);
+    categorical fields list their values in encoding order."""
+    dd = ET.Element(_q("DataDictionary"),
+                    {"numberOfFields": str(schema.num_features)})
+    for f, name in enumerate(schema.feature_names):
+        attrs = {"name": name}
+        if schema.is_numeric(name):
+            attrs["optype"] = "continuous"
+            attrs["dataType"] = "double"
+        elif schema.is_categorical(name):
+            attrs["optype"] = "categorical"
+            attrs["dataType"] = "string"
+        field = ET.SubElement(dd, _q("DataField"), attrs)
+        if schema.is_categorical(name) and encodings is not None \
+                and f in encodings.get_category_counts():
+            for i in range(encodings.get_value_count(f)):
+                ET.SubElement(field, _q("Value"),
+                              {"value": encodings.decode(f, i)})
+    return dd
+
+
+def get_feature_names(parent: Element) -> list[str]:
+    """Feature names in order from a MiningSchema or DataDictionary
+    child element."""
+    return [el.get("name") for el in parent
+            if el.tag in (_q("MiningField"), _q("DataField"))]
+
+
+def find_target_index(mining_schema: Element) -> int | None:
+    for i, el in enumerate(mining_schema.findall(_q("MiningField"))):
+        if el.get("usageType") == "predicted":
+            return i
+    return None
+
+
+def build_categorical_value_encodings(
+        data_dictionary: Element) -> CategoricalValueEncodings:
+    """Reverse of build_data_dictionary: per-feature value lists from
+    DataField/Value elements (reference:
+    buildCategoricalValueEncodings :244)."""
+    index_to_values: dict[int, list[str]] = {}
+    for f, field in enumerate(data_dictionary.findall(_q("DataField"))):
+        values = [v.get("value") for v in field.findall(_q("Value"))]
+        if values:
+            index_to_values[f] = values
+    return CategoricalValueEncodings(index_to_values)
+
+
+def to_pmml_array(values) -> Element:
+    """PMML real Array element from numbers (reference: toArray :116)."""
+    vals = [float(v) for v in values]
+    arr = ET.Element(_q("Array"), {"type": "real", "n": str(len(vals))})
+    arr.text = text_utils.join_pmml_delimited_numbers(vals)
+    return arr
 
 
 def read_pmml_from_update_key_message(key: str, message: str) -> Element | None:

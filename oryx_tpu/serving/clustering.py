@@ -1,0 +1,84 @@
+"""Clustering REST endpoints.
+
+Reference: app/oryx-app-serving/src/main/java/com/cloudera/oryx/app/
+serving/clustering/Assign.java:52 (GET /assign/{datum} + POST bulk),
+Add.java:43 (write datum to input topic), kmeans/DistanceToNearest.java:40.
+"""
+
+from __future__ import annotations
+
+from ..api.serving import OryxServingException
+from ..common import text as text_utils
+from ..lambda_rt.http import Request, Route
+from .framework import get_serving_model, send_input
+
+__all__ = ["ROUTES"]
+
+
+def _model(req: Request):
+    return get_serving_model(req)
+
+
+def _tokens(datum: str) -> list[str]:
+    if not datum:
+        raise OryxServingException(400, "Data is needed to cluster")
+    return text_utils.parse_delimited(datum, ",")
+
+
+def _assign_get(req: Request):
+    model = _model(req)
+    try:
+        return str(model.nearest_cluster_id(_tokens(req.params["datum"])))
+    except (ValueError, KeyError) as e:
+        raise OryxServingException(400, str(e))
+
+
+def _assign_post(req: Request):
+    """Bulk assignment: one device kernel over all POSTed lines."""
+    model = _model(req)
+    lines = [ln.strip() for ln in req.body.decode().splitlines()
+             if ln.strip()]
+    rows = [_tokens(ln) for ln in lines]
+    try:
+        return [str(i) for i in model.nearest_cluster_ids(rows)]
+    except (ValueError, KeyError) as e:
+        raise OryxServingException(400, str(e))
+
+
+def _add(req: Request):
+    _model(req)  # 503 gate
+    datum = req.params["datum"]
+    if not datum:
+        raise OryxServingException(400, "Data is needed")
+    send_input(req, datum)
+    return None
+
+
+def _add_post(req: Request):
+    _model(req)
+    lines = [ln.strip() for ln in req.body.decode().splitlines()
+             if ln.strip()]
+    for line in lines:
+        send_input(req, line)
+    return None
+
+
+def _distance_to_nearest(req: Request):
+    model = _model(req)
+    try:
+        vec_tokens = _tokens(req.params["datum"])
+        from ..app.kmeans.common import features_from_tokens
+        vec = features_from_tokens(vec_tokens, model.input_schema)
+        _, dist = model.closest_cluster(vec)
+    except (ValueError, KeyError) as e:
+        raise OryxServingException(400, str(e))
+    return str(dist)
+
+
+ROUTES = [
+    Route("GET", "/assign/{datum}", _assign_get),
+    Route("POST", "/assign", _assign_post),
+    Route("GET", "/add/{datum}", _add),
+    Route("POST", "/add", _add_post),
+    Route("GET", "/distanceToNearest/{datum}", _distance_to_nearest),
+]

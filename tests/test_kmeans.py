@@ -1,0 +1,291 @@
+"""k-means app tests (reference analogs: KMeansUpdateIT,
+KMeansSpeedIT, KMeansServingModelManagerIT, ClusterInfo/KMeansUtils/
+KMeansPMMLUtils unit tests, the four eval-index tests)."""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from oryx_tpu.app.kmeans import evaluation
+from oryx_tpu.app.kmeans import pmml as kmeans_pmml
+from oryx_tpu.app.kmeans.common import (ClusterInfo, assign_points,
+                                        closest_cluster,
+                                        features_from_tokens)
+from oryx_tpu.app.kmeans.serving import (KMeansServingModel,
+                                         KMeansServingModelManager)
+from oryx_tpu.app.kmeans.speed import KMeansSpeedModelManager
+from oryx_tpu.app.kmeans.trainer import train_kmeans
+from oryx_tpu.app.kmeans.update import KMeansUpdate
+from oryx_tpu.app.schema import InputSchema
+from oryx_tpu.common import pmml as pmml_io
+from oryx_tpu.common.config import from_dict
+from oryx_tpu.kafka.api import KEY_MODEL, KEY_UP, KeyMessage
+
+
+def _schema(n=2):
+    return InputSchema(from_dict({"oryx.input-schema.num-features": n,
+                                  "oryx.input-schema.numeric-features":
+                                      [str(i) for i in range(n)]}))
+
+
+def _blobs(n_per=50, seed=0):
+    rng = np.random.default_rng(seed)
+    cs = np.array([[0.0, 0.0], [10.0, 0.0], [0.0, 10.0]])
+    pts = np.concatenate([c + rng.standard_normal((n_per, 2)) * 0.5
+                          for c in cs]).astype(np.float32)
+    return pts, cs
+
+
+# -- ClusterInfo / assignment ------------------------------------------------
+
+def test_cluster_info_moving_average_update():
+    c = ClusterInfo(0, [1.0, 1.0], 2)
+    c.update([4.0, 4.0], 1)
+    # c' = c + (1/3)(p - c) = 2.0
+    np.testing.assert_allclose(c.center, [2.0, 2.0])
+    assert c.count == 3
+
+
+def test_closest_cluster_and_batch_assign_agree():
+    pts, cs = _blobs()
+    clusters = [ClusterInfo(i, cs[i], 1) for i in range(3)]
+    idx, dist = assign_points(pts, cs.astype(np.float32))
+    for p, i, d in zip(pts[::17], idx[::17], dist[::17]):
+        ci, cd = closest_cluster(clusters, p)
+        assert ci.id == i
+        np.testing.assert_allclose(cd, d, rtol=1e-4)
+
+
+def test_features_from_tokens_skips_inactive():
+    schema = InputSchema(from_dict({
+        "oryx.input-schema.feature-names": ["id", "a", "b"],
+        "oryx.input-schema.id-features": ["id"],
+        "oryx.input-schema.numeric-features": ["a", "b"]}))
+    vec = features_from_tokens(["x1", "2.0", "3.0"], schema)
+    np.testing.assert_allclose(vec, [2.0, 3.0])
+
+
+# -- trainer -----------------------------------------------------------------
+
+@pytest.mark.parametrize("init", ["k-means||", "random"])
+def test_train_kmeans_recovers_blobs(init):
+    pts, cs = _blobs()
+    clusters = train_kmeans(pts, k=3, iterations=20, runs=2,
+                            initialization=init, seed=42)
+    # each true center must have exactly one found center nearby
+    matched = set()
+    for want in cs:
+        dists = [float(np.linalg.norm(c.center - want)) for c in clusters]
+        j = int(np.argmin(dists))
+        assert dists[j] < 0.5 and j not in matched
+        matched.add(j)
+    assert sum(c.count for c in clusters) == len(pts)
+
+
+# -- evals -------------------------------------------------------------------
+
+def test_eval_indices_prefer_true_clustering():
+    pts, cs = _blobs()
+    good = [ClusterInfo(i, cs[i], 1) for i in range(3)]
+    bad_cs = np.array([[5.0, 5.0], [5.2, 5.0], [4.8, 5.2]])
+    bad = [ClusterInfo(i, bad_cs[i], 1) for i in range(3)]
+    for strategy in evaluation.EVAL_STRATEGIES:
+        g = evaluation.evaluate(strategy, good, pts)
+        b = evaluation.evaluate(strategy, bad, pts)
+        assert g > b, strategy
+
+
+def test_silhouette_bounds_and_singletons():
+    pts, cs = _blobs(n_per=20)
+    clusters = [ClusterInfo(i, cs[i], 1) for i in range(3)]
+    s = evaluation.silhouette_coefficient(clusters, pts)
+    assert -1.0 <= s <= 1.0
+    assert s > 0.5  # well-separated blobs
+
+
+# -- PMML --------------------------------------------------------------------
+
+def test_clustering_pmml_roundtrip():
+    schema = _schema()
+    clusters = [ClusterInfo(0, [1.0, 2.0], 10), ClusterInfo(1, [3.5, -1.25], 4)]
+    doc = kmeans_pmml.clusters_to_pmml(clusters, schema)
+    s = pmml_io.to_string(doc)
+    back = kmeans_pmml.read_clusters(pmml_io.from_string(s))
+    assert [c.id for c in back] == [0, 1]
+    assert [c.count for c in back] == [10, 4]
+    np.testing.assert_allclose(back[1].center, [3.5, -1.25])
+    kmeans_pmml.validate_pmml_vs_schema(doc, schema)
+    with pytest.raises(ValueError):
+        kmeans_pmml.validate_pmml_vs_schema(doc, _schema(3))
+
+
+# -- batch update through the ML loop ---------------------------------------
+
+def _batch_config(tmp_path, k=3):
+    return from_dict({
+        "oryx.ml.eval.test-fraction": 0.2,
+        "oryx.ml.eval.candidates": 1,
+        "oryx.ml.eval.parallelism": 1,
+        "oryx.ml.eval.threshold": None,
+        "oryx.update-topic.message.max-size": 1 << 24,
+        "oryx.kmeans.iterations": 15,
+        "oryx.kmeans.initialization-strategy": "k-means||",
+        "oryx.kmeans.evaluation-strategy": "SILHOUETTE",
+        "oryx.kmeans.runs": 1,
+        "oryx.kmeans.hyperparams.k": k,
+        "oryx.input-schema.num-features": 2,
+        "oryx.input-schema.numeric-features": ["0", "1"],
+    })
+
+
+def test_kmeans_update_builds_and_evaluates(tmp_path):
+    pts, _ = _blobs()
+    data = [KeyMessage(None, f"{p[0]},{p[1]}") for p in pts]
+    update = KMeansUpdate(_batch_config(tmp_path))
+    doc = update.build_model(data, [3], str(tmp_path))
+    assert doc is not None
+    clusters = kmeans_pmml.read_clusters(doc)
+    assert len(clusters) == 3
+    ev = update.evaluate(doc, str(tmp_path), data[:30], data[30:])
+    assert ev > 0.5  # silhouette of well-separated blobs
+
+
+def test_kmeans_update_rejects_categorical():
+    cfg = from_dict({
+        "oryx.ml.eval.test-fraction": 0.2,
+        "oryx.ml.eval.candidates": 1,
+        "oryx.ml.eval.parallelism": 1,
+        "oryx.ml.eval.threshold": None,
+        "oryx.update-topic.message.max-size": 1 << 24,
+        "oryx.kmeans.iterations": 5,
+        "oryx.kmeans.initialization-strategy": "k-means||",
+        "oryx.kmeans.evaluation-strategy": "SSE",
+        "oryx.kmeans.runs": 1,
+        "oryx.kmeans.hyperparams.k": 2,
+        "oryx.input-schema.num-features": 2,
+        "oryx.input-schema.categorical-features": ["1"],
+    })
+    with pytest.raises(ValueError):
+        KMeansUpdate(cfg)
+
+
+# -- speed -------------------------------------------------------------------
+
+def _kmeans_model_message():
+    schema = _schema()
+    clusters = [ClusterInfo(0, [0.0, 0.0], 10),
+                ClusterInfo(1, [10.0, 10.0], 10)]
+    return pmml_io.to_string(kmeans_pmml.clusters_to_pmml(clusters, schema))
+
+
+def test_speed_manager_emits_center_updates():
+    cfg = from_dict({"oryx.input-schema.num-features": 2,
+                     "oryx.input-schema.numeric-features": ["0", "1"]})
+    mgr = KMeansSpeedModelManager(cfg)
+    mgr.consume_key_message(KEY_MODEL, _kmeans_model_message())
+    assert mgr.model is not None
+    data = [KeyMessage(None, "0.5,0.5"), KeyMessage(None, "-0.5,-0.5"),
+            KeyMessage(None, "10.5,10.5")]
+    ups = list(mgr.build_updates(data))
+    assert len(ups) == 2
+    parsed = [json.loads(u) for u in ups]
+    by_id = {p[0]: p for p in parsed}
+    assert by_id[0][2] == 12  # 10 + 2 points
+    assert by_id[1][2] == 11
+    # cluster 0: mean of (.5,.5),(-.5,-.5)=(0,0), center stays ~0
+    np.testing.assert_allclose(by_id[0][1], [0.0, 0.0], atol=1e-6)
+    # UP messages are ignored when consumed back
+    mgr.consume_key_message(KEY_UP, ups[0])
+
+
+# -- serving -----------------------------------------------------------------
+
+def test_serving_manager_model_and_up():
+    cfg = from_dict({"oryx.input-schema.num-features": 2,
+                     "oryx.input-schema.numeric-features": ["0", "1"],
+                     "oryx.serving.api.read-only": False})
+    mgr = KMeansServingModelManager(cfg)
+    mgr.consume_key_message(KEY_UP, "[0,[1.0,1.0],5]")  # ignored, no model
+    assert mgr.get_model() is None
+    mgr.consume_key_message(KEY_MODEL, _kmeans_model_message())
+    model = mgr.get_model()
+    assert model.nearest_cluster_id(["1.0", "0.5"]) == 0
+    assert model.nearest_cluster_id(["9.0", "9.5"]) == 1
+    mgr.consume_key_message(KEY_UP, "[1,[20.0,20.0],42]")
+    assert model.get_cluster(1).count == 42
+    np.testing.assert_allclose(model.get_cluster(1).center, [20.0, 20.0])
+    assert model.nearest_cluster_ids([["1.0", "0.5"], ["19.0", "19.5"]]) \
+        == [0, 1]
+
+
+# -- REST endpoints over live HTTP ------------------------------------------
+
+class MockKMeansManager(KMeansServingModelManager):
+    pass
+
+
+@pytest.fixture(scope="module")
+def kmeans_server():
+    from oryx_tpu.lambda_rt.serving import ServingLayer
+    from oryx_tpu.kafka.inproc import get_broker
+    cfg = from_dict({
+        "oryx.serving.model-manager-class":
+            "tests.test_kmeans.MockKMeansManager",
+        "oryx.serving.application-resources": "oryx_tpu.serving.clustering",
+        "oryx.input-topic.broker": "memory://kmeans-test",
+        "oryx.input-topic.message.topic": "KInput",
+        "oryx.update-topic.broker": "memory://kmeans-test",
+        "oryx.update-topic.message.topic": "KUpdate",
+        "oryx.input-schema.num-features": 2,
+        "oryx.input-schema.numeric-features": ["0", "1"],
+    })
+    broker = get_broker("kmeans-test")
+    broker.send("KUpdate", KEY_MODEL, _kmeans_model_message())
+    layer = ServingLayer(cfg, port=0)
+    layer.start()
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{layer.port}/ready", timeout=2)
+            break
+        except Exception:
+            time.sleep(0.1)
+    yield layer, broker
+    layer.close()
+
+
+def _get(layer, path):
+    return urllib.request.urlopen(
+        f"http://127.0.0.1:{layer.port}{path}", timeout=10)
+
+
+def test_assign_endpoint(kmeans_server):
+    layer, _ = kmeans_server
+    assert _get(layer, "/assign/0.4,0.6").read().decode().strip('"') == "0"
+    assert _get(layer, "/assign/9.5,10.2").read().decode().strip('"') == "1"
+
+
+def test_assign_post_bulk(kmeans_server):
+    layer, _ = kmeans_server
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{layer.port}/assign",
+        data=b"0.4,0.6\n9.5,10.2\n", method="POST")
+    out = json.loads(urllib.request.urlopen(req, timeout=10).read())
+    assert out == ["0", "1"]
+
+
+def test_distance_to_nearest_endpoint(kmeans_server):
+    layer, _ = kmeans_server
+    d = float(json.loads(_get(layer, "/distanceToNearest/0,1").read()))
+    np.testing.assert_allclose(d, 1.0, atol=1e-5)
+
+
+def test_add_endpoint_writes_input(kmeans_server):
+    layer, broker = kmeans_server
+    before = broker.latest_offset("KInput")
+    _get(layer, "/add/1.0,2.0")
+    assert broker.latest_offset("KInput") == before + 1

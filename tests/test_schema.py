@@ -1,0 +1,83 @@
+"""InputSchema + CategoricalValueEncodings tests (reference:
+InputSchemaTest.java:28, CategoricalValueEncodingsTest)."""
+
+import pytest
+
+from oryx_tpu.app.schema import CategoricalValueEncodings, InputSchema
+from oryx_tpu.common.config import from_dict
+
+
+def test_generated_feature_names():
+    s = InputSchema(from_dict({"oryx.input-schema.num-features": 3,
+                               "oryx.input-schema.numeric-features":
+                                   ["0", "1", "2"]}))
+    assert s.feature_names == ["0", "1", "2"]
+    assert s.num_predictors == 3
+    assert not s.has_target()
+
+
+def test_id_ignored_target_and_predictor_map():
+    s = InputSchema(from_dict({
+        "oryx.input-schema.feature-names": ["id", "a", "b", "c", "junk"],
+        "oryx.input-schema.id-features": ["id"],
+        "oryx.input-schema.ignored-features": ["junk"],
+        "oryx.input-schema.categorical-features": ["b"],
+        "oryx.input-schema.target-feature": "c"}))
+    assert s.is_id("id") and s.is_id(0)
+    assert not s.is_active(0) and s.is_active("a")
+    assert s.is_numeric("a") and s.is_numeric("c")
+    assert s.is_categorical("b") and s.is_categorical(2)
+    assert s.is_target(3) and s.has_target()
+    assert s.target_feature_index == 3
+    # predictors are a and b only (c is target, id/junk inactive)
+    assert s.num_predictors == 2
+    assert s.feature_to_predictor_index(1) == 0
+    assert s.feature_to_predictor_index(2) == 1
+    assert s.predictor_to_feature_index(1) == 2
+
+
+def test_numeric_features_variant():
+    s = InputSchema(from_dict({
+        "oryx.input-schema.feature-names": ["a", "b"],
+        "oryx.input-schema.numeric-features": ["a"]}))
+    assert s.is_categorical("b")
+
+
+def test_schema_validation_errors():
+    with pytest.raises(ValueError):
+        InputSchema(from_dict({"oryx.input-schema.num-features": 0}))
+    with pytest.raises(ValueError):
+        InputSchema(from_dict({
+            "oryx.input-schema.feature-names": ["a", "a"],
+            "oryx.input-schema.numeric-features": ["a"]}))
+    with pytest.raises(ValueError):
+        InputSchema(from_dict({
+            "oryx.input-schema.feature-names": ["a"],
+            "oryx.input-schema.id-features": ["nope"],
+            "oryx.input-schema.numeric-features": ["a"]}))
+    with pytest.raises(ValueError):
+        InputSchema(from_dict({
+            "oryx.input-schema.feature-names": ["a", "b"],
+            "oryx.input-schema.numeric-features": ["a", "b"],
+            "oryx.input-schema.target-feature": "zz"}))
+
+
+def test_categorical_value_encodings():
+    enc = CategoricalValueEncodings({0: ["x", "y", "x", "z"], 2: ["p"]})
+    assert enc.get_value_count(0) == 3
+    assert enc.encode(0, "y") == 1
+    assert enc.decode(0, 2) == "z"
+    assert enc.get_category_counts() == {0: 3, 2: 1}
+    assert enc.get_value_encoding_map(0) == {"x": 0, "y": 1, "z": 2}
+    assert enc.get_encoding_value_map(2) == {0: "p"}
+
+
+def test_encodings_from_data():
+    s = InputSchema(from_dict({
+        "oryx.input-schema.feature-names": ["a", "b"],
+        "oryx.input-schema.categorical-features": ["b"]}))
+    rows = [["1", "red"], ["2", "blue"], ["3", "red"]]
+    enc = CategoricalValueEncodings.from_data(rows, s)
+    assert enc.get_value_count(1) == 2
+    assert enc.encode(1, "red") == 0
+    assert enc.encode(1, "blue") == 1
