@@ -1,0 +1,3 @@
+"""Random decision forest app family: host tree structures, the
+device-array forest representation, the JAX histogram trainer, PMML
+I/O, and the batch/speed/serving tiers."""

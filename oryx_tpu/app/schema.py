@@ -119,6 +119,11 @@ class InputSchema:
     def has_target(self) -> bool:
         return self.target_feature is not None
 
+    def is_classification(self) -> bool:
+        """Whether the target is categorical (reference:
+        InputSchema.isClassification)."""
+        return self.has_target() and self.is_categorical(self.target_feature)
+
     def feature_to_predictor_index(self, feature_index: int) -> int:
         return self._feature_to_predictor[feature_index]
 
@@ -159,6 +164,11 @@ class CategoricalValueEncodings:
 
     def encode(self, feature_index: int, value: str) -> int:
         return self._encodings[feature_index][value]
+
+    def try_encode(self, feature_index: int, value: str) -> int | None:
+        """Encoding, or None for a value (or feature) with no
+        dictionary entry."""
+        return self._encodings.get(feature_index, {}).get(value)
 
     def decode(self, feature_index: int, encoding: int) -> str:
         return self._decodings[feature_index][encoding]

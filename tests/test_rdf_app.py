@@ -1,0 +1,285 @@
+"""RDF app tests: batch update, speed manager, serving manager, and the
+classreg REST endpoints (reference: RDFUpdateIT, RDFSpeedIT,
+RDFServingModelManagerIT, PredictTest, ClassificationDistributionTest,
+FeatureImportanceTest, TrainTest)."""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from oryx_tpu.app.rdf import pmml as rdf_pmml
+from oryx_tpu.app.rdf.serving import RDFServingModelManager
+from oryx_tpu.app.rdf.speed import RDFSpeedModelManager
+from oryx_tpu.app.rdf.update import RDFUpdate
+from oryx_tpu.common import pmml as pmml_io
+from oryx_tpu.common.config import from_dict
+from oryx_tpu.kafka.api import KEY_MODEL, KEY_UP, KeyMessage
+
+
+def _schema_entries():
+    return {
+        "oryx.input-schema.feature-names": ["a", "color", "label"],
+        "oryx.input-schema.categorical-features": ["color", "label"],
+        "oryx.input-schema.target-feature": "label",
+    }
+
+
+def _batch_config():
+    return from_dict({
+        "oryx.ml.eval.test-fraction": 0.2,
+        "oryx.ml.eval.candidates": 1,
+        "oryx.ml.eval.parallelism": 1,
+        "oryx.ml.eval.threshold": None,
+        "oryx.update-topic.message.max-size": 1 << 24,
+        "oryx.rdf.num-trees": 3,
+        "oryx.rdf.hyperparams.max-split-candidates": 16,
+        "oryx.rdf.hyperparams.max-depth": 4,
+        "oryx.rdf.hyperparams.impurity": "gini",
+        **_schema_entries(),
+    })
+
+
+def _lines(n=400, seed=11):
+    rng = np.random.default_rng(seed)
+    lines = []
+    for _ in range(n):
+        a = rng.uniform(-1, 1)
+        color = rng.choice(["red", "green", "blue"])
+        label = "yes" if (a >= 0.1 or color == "blue") else "no"
+        lines.append(f"{a:.4f},{color},{label}")
+    return lines
+
+
+def test_rdf_update_builds_and_evaluates(tmp_path):
+    data = [KeyMessage(None, ln) for ln in _lines()]
+    update = RDFUpdate(_batch_config())
+    doc = update.build_model(data, [16, 4, "gini"], str(tmp_path))
+    assert doc is not None
+    forest, encodings = rdf_pmml.read_forest(doc)
+    assert len(forest.trees) == 3
+    accuracy = update.evaluate(doc, str(tmp_path), data[:80], data[80:])
+    assert accuracy > 0.9
+    # importances present in PMML mining schema
+    assert "importance" in pmml_io.to_string(doc)
+
+
+def test_rdf_update_regression(tmp_path):
+    cfg = from_dict({
+        "oryx.ml.eval.test-fraction": 0.2,
+        "oryx.ml.eval.candidates": 1,
+        "oryx.ml.eval.parallelism": 1,
+        "oryx.ml.eval.threshold": None,
+        "oryx.update-topic.message.max-size": 1 << 24,
+        "oryx.rdf.num-trees": 3,
+        "oryx.rdf.hyperparams.max-split-candidates": 64,
+        "oryx.rdf.hyperparams.max-depth": 3,
+        "oryx.rdf.hyperparams.impurity": "variance",
+        "oryx.input-schema.feature-names": ["a", "y"],
+        "oryx.input-schema.numeric-features": ["a", "y"],
+        "oryx.input-schema.target-feature": "y",
+    })
+    rng = np.random.default_rng(2)
+    data = []
+    for _ in range(300):
+        a = rng.uniform(0, 4)
+        y = 1.0 if a < 2 else 5.0
+        data.append(KeyMessage(None, f"{a:.4f},{y}"))
+    update = RDFUpdate(cfg)
+    doc = update.build_model(data, [64, 3, "variance"], "unused")
+    ev = update.evaluate(doc, "unused", data[:50], data[50:])
+    assert ev > -0.5  # -RMSE
+
+
+def _model_message():
+    data = [KeyMessage(None, ln) for ln in _lines()]
+    update = RDFUpdate(_batch_config())
+    doc = update.build_model(data, [16, 4, "gini"], "unused")
+    return pmml_io.to_string(doc)
+
+
+@pytest.fixture(scope="module")
+def model_message():
+    return _model_message()
+
+
+def test_speed_manager_routes_and_emits(model_message):
+    cfg = from_dict(_schema_entries())
+    mgr = RDFSpeedModelManager(cfg)
+    mgr.consume_key_message(KEY_MODEL, model_message)
+    assert mgr.model is not None
+    data = [KeyMessage(None, "0.9,red,yes"), KeyMessage(None, "0.8,red,yes"),
+            KeyMessage(None, "-0.9,green,no"),
+            KeyMessage(None, "0.5,blue,")]  # no target -> skipped
+    ups = list(mgr.build_updates(data))
+    assert ups
+    parsed = [json.loads(u) for u in ups]
+    # one update per (tree, terminal node) with 3 routed examples
+    for p in parsed:
+        assert isinstance(p[0], int) and isinstance(p[1], str)
+        assert p[1].startswith("r")
+        assert isinstance(p[2], dict)
+    total = sum(sum(p[2].values()) for p in parsed)
+    assert total == 3 * 3  # 3 examples x 3 trees
+    mgr.consume_key_message(KEY_UP, ups[0])  # ignored
+
+
+def test_serving_manager_predict_and_up(model_message):
+    cfg = from_dict({**_schema_entries(),
+                     "oryx.serving.api.read-only": False})
+    mgr = RDFServingModelManager(cfg)
+    mgr.consume_key_message(KEY_UP, '[0,"r",{"0":1}]')  # no model yet: skip
+    assert mgr.get_model() is None
+    mgr.consume_key_message(KEY_MODEL, model_message)
+    model = mgr.get_model()
+    assert model.predict(["0.9", "red", ""]) == "yes"
+    assert model.predict(["-0.9", "green", ""]) == "no"
+    bulk = model.predict_bulk([["0.9", "red", ""], ["-0.9", "green", ""]])
+    assert bulk == ["yes", "no"]
+    # distribution sums to 1
+    pred = model.make_prediction(["0.9", "blue", ""])
+    assert pred.category_probabilities.sum() == pytest.approx(1.0)
+    # leaf update shifts the prediction stats of a terminal node
+    leaf = model.forest.trees[0].find_terminal(
+        model._example(["0.9", "red", ""]))
+    enc_no = model.encodings.encode(2, "no")
+    before = leaf.prediction.category_counts[enc_no]
+    mgr.consume_key_message(
+        KEY_UP, json.dumps([0, leaf.id, {str(enc_no): 50}]))
+    assert leaf.prediction.category_counts[enc_no] == before + 50
+    with pytest.raises(ValueError):
+        model.predict(["0.9", "red"])  # wrong feature count
+
+
+# -- REST endpoints over live HTTP -------------------------------------------
+
+class MockRDFManager(RDFServingModelManager):
+    pass
+
+
+@pytest.fixture(scope="module")
+def rdf_server(model_message):
+    from oryx_tpu.lambda_rt.serving import ServingLayer
+    from oryx_tpu.kafka.inproc import get_broker
+    cfg = from_dict({
+        "oryx.serving.model-manager-class":
+            "tests.test_rdf_app.MockRDFManager",
+        "oryx.serving.application-resources": "oryx_tpu.serving.classreg",
+        "oryx.input-topic.broker": "memory://rdf-test",
+        "oryx.input-topic.message.topic": "RInput",
+        "oryx.update-topic.broker": "memory://rdf-test",
+        "oryx.update-topic.message.topic": "RUpdate",
+        **_schema_entries(),
+    })
+    broker = get_broker("rdf-test")
+    broker.send("RUpdate", KEY_MODEL, model_message)
+    layer = ServingLayer(cfg, port=0)
+    layer.start()
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{layer.port}/ready", timeout=2)
+            break
+        except Exception:
+            time.sleep(0.1)
+    yield layer, broker
+    layer.close()
+
+
+def _get(layer, path):
+    return urllib.request.urlopen(
+        f"http://127.0.0.1:{layer.port}{path}", timeout=10)
+
+
+def test_predict_endpoint(rdf_server):
+    layer, _ = rdf_server
+    assert json.loads(_get(layer, "/predict/0.9,red,").read()) == "yes"
+
+
+def test_predict_post_bulk(rdf_server):
+    layer, _ = rdf_server
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{layer.port}/predict",
+        data=b"0.9,red,\n-0.9,green,\n", method="POST")
+    assert json.loads(urllib.request.urlopen(req, timeout=10).read()) == \
+        ["yes", "no"]
+
+
+def test_classification_distribution(rdf_server):
+    layer, _ = rdf_server
+    out = json.loads(_get(layer, "/classificationDistribution/0.9,red,")
+                     .read())
+    labels = {o["id"] for o in out}
+    assert labels == {"yes", "no"}
+    assert sum(o["value"] for o in out) == pytest.approx(1.0)
+
+
+def test_feature_importance(rdf_server):
+    layer, _ = rdf_server
+    imps = json.loads(_get(layer, "/feature/importance").read())
+    assert len(imps) == 3
+    assert sum(imps) == pytest.approx(1.0)
+    one = json.loads(_get(layer, "/feature/importance/0").read())
+    assert one == pytest.approx(imps[0])
+
+
+def test_update_skips_unlabeled_and_unseen_values(tmp_path):
+    data = [KeyMessage(None, ln) for ln in _lines(200)]
+    data.append(KeyMessage(None, "0.5,red,"))        # unlabeled
+    update = RDFUpdate(_batch_config())
+    doc = update.build_model(data, [16, 4, "gini"], str(tmp_path))
+    _, encodings = rdf_pmml.read_forest(doc)
+    # '' must not become a phantom class
+    assert "" not in encodings.get_value_encoding_map(2)
+    # unseen categorical value in test data is treated as missing,
+    # unseen target value is skipped -- neither crashes evaluate
+    test = [KeyMessage(None, "0.9,purple,yes"),
+            KeyMessage(None, "0.9,red,maybe")] + data[:40]
+    accuracy = update.evaluate(doc, str(tmp_path), test, data)
+    assert 0.0 <= accuracy <= 1.0
+
+
+def test_train_endpoint_works_without_model(model_message):
+    """Training data must flow before the first model exists."""
+    from oryx_tpu.lambda_rt.serving import ServingLayer
+    from oryx_tpu.kafka.inproc import get_broker
+    cfg = from_dict({
+        "oryx.serving.model-manager-class":
+            "tests.test_rdf_app.MockRDFManager",
+        "oryx.serving.application-resources": "oryx_tpu.serving.classreg",
+        "oryx.input-topic.broker": "memory://rdf-nomodel",
+        "oryx.input-topic.message.topic": "RInput",
+        "oryx.update-topic.broker": "memory://rdf-nomodel",
+        "oryx.update-topic.message.topic": "RUpdate",
+        **_schema_entries(),
+    })
+    broker = get_broker("rdf-nomodel")
+    layer = ServingLayer(cfg, port=0)
+    layer.start()
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            try:
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{layer.port}/train/0.5,red,yes",
+                    data=b"", method="POST")
+                urllib.request.urlopen(req, timeout=2)
+                break
+            except urllib.error.URLError:
+                time.sleep(0.1)
+        assert broker.latest_offset("RInput") >= 1
+    finally:
+        layer.close()
+
+
+def test_train_endpoint_writes_input(rdf_server):
+    layer, broker = rdf_server
+    before = broker.latest_offset("RInput")
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{layer.port}/train/0.5,red,yes", data=b"",
+        method="POST")
+    urllib.request.urlopen(req, timeout=10)
+    assert broker.latest_offset("RInput") == before + 1

@@ -1,0 +1,195 @@
+"""RDF common-tier tests: decisions, trees, predictions, PMML
+round-trip, and the device-array forest kernel (reference tests:
+DecisionTreeTest.java:26, NumericDecisionTest, CategoricalDecisionTest,
+CategoricalPredictionTest, NumericPredictionTest, WeightedPredictionTest,
+RDFPMMLUtilsTest.java:54)."""
+
+import numpy as np
+import pytest
+
+from oryx_tpu.app.classreg import (CategoricalPrediction, Example,
+                                   NumericPrediction, example_from_tokens,
+                                   vote_on_feature)
+from oryx_tpu.app.rdf import pmml as rdf_pmml
+from oryx_tpu.app.rdf.forest_arrays import ForestArrays, examples_to_matrix
+from oryx_tpu.app.rdf.tree import (CategoricalDecision, DecisionForest,
+                                   DecisionNode, DecisionTree,
+                                   NumericDecision, TerminalNode)
+from oryx_tpu.app.schema import CategoricalValueEncodings, InputSchema
+from oryx_tpu.common.config import from_dict
+from oryx_tpu.common.pmml import to_string, from_string
+
+
+def _classification_schema():
+    return InputSchema(from_dict({
+        "oryx.input-schema.feature-names": ["color", "size", "fruit"],
+        "oryx.input-schema.categorical-features": ["color", "fruit"],
+        "oryx.input-schema.target-feature": "fruit"}))
+
+
+def _encodings():
+    return CategoricalValueEncodings({0: ["red", "green"],
+                                      2: ["apple", "lime", "cherry"]})
+
+
+def _classification_tree():
+    # (#1 size >= 2.0) ? ((#0 color in {red}) ? cherry-ish : lime) : apple
+    right = DecisionNode(
+        "r+", CategoricalDecision(0, [0], False),
+        TerminalNode("r+-", CategoricalPrediction([0, 9, 1])),
+        TerminalNode("r++", CategoricalPrediction([1, 1, 8])),
+        count=10)
+    root = DecisionNode(
+        "r", NumericDecision(1, 2.0, True),
+        TerminalNode("r-", CategoricalPrediction([8, 1, 1])),
+        right, count=20)
+    return DecisionTree(root)
+
+
+def test_numeric_decision():
+    d = NumericDecision(1, 2.0, True)
+    assert d.is_positive(Example(None, [None, 2.0, None]))
+    assert not d.is_positive(Example(None, [None, 1.9, None]))
+    assert d.is_positive(Example(None, [None, None, None]))  # default
+
+
+def test_categorical_decision():
+    d = CategoricalDecision(0, [0, 2], False)
+    assert d.is_positive(Example(None, [0, None, None]))
+    assert not d.is_positive(Example(None, [1, None, None]))
+    assert not d.is_positive(Example(None, [None, None, None]))
+
+
+def test_tree_walk_and_find_by_id():
+    tree = _classification_tree()
+    leaf = tree.find_terminal(Example(None, [1, 5.0, None]))
+    assert leaf.id == "r+-"
+    assert tree.find_by_id("r++").id == "r++"
+    assert tree.find_by_id("r").id == "r"
+    with pytest.raises(ValueError):
+        tree.find_by_id("r--")
+
+
+def test_predictions_update():
+    p = CategoricalPrediction([2, 1, 0])
+    assert p.get_most_probable_category_encoding() == 0
+    p.update(2, 5)
+    assert p.get_most_probable_category_encoding() == 2
+    assert p.count == 8
+    n = NumericPrediction(1.0, 1)
+    n.update(3.0, 1)
+    assert n.prediction == pytest.approx(2.0)
+    assert n.count == 2
+
+
+def test_weighted_vote():
+    votes = [CategoricalPrediction([1, 0]), CategoricalPrediction([0, 1]),
+             CategoricalPrediction([1, 0])]
+    combined = vote_on_feature(votes, [1.0, 1.0, 1.0])
+    assert combined.get_most_probable_category_encoding() == 0
+    nums = [NumericPrediction(1.0, 1), NumericPrediction(2.0, 1)]
+    assert vote_on_feature(nums, [1.0, 3.0]).prediction == \
+        pytest.approx(1.75)
+
+
+def test_example_from_tokens():
+    schema = _classification_schema()
+    ex = example_from_tokens(["green", "1.5", "lime"], schema, _encodings())
+    assert ex.features == [1, 1.5, None]
+    assert ex.target == 1
+    ex2 = example_from_tokens(["red", "3", ""], schema, _encodings())
+    assert ex2.target is None
+
+
+def test_pmml_round_trip_classification():
+    schema = _classification_schema()
+    encodings = _encodings()
+    forest = DecisionForest([_classification_tree(),
+                             _classification_tree()],
+                            [1.0, 1.0], [0.4, 0.6, 0.0])
+    pmml = rdf_pmml.forest_to_pmml(forest, schema, encodings,
+                                   max_depth=8, max_split_candidates=10,
+                                   impurity="entropy")
+    rdf_pmml.validate_pmml_vs_schema(pmml, schema)
+    round_tripped = from_string(to_string(pmml))
+    forest2, encodings2 = rdf_pmml.read_forest(round_tripped)
+    assert len(forest2.trees) == 2
+    assert encodings2.get_value_encoding_map(2) == \
+        encodings.get_value_encoding_map(2)
+    assert list(forest2.feature_importances) == [0.4, 0.6, 0.0]
+    for tokens in (["red", "5", ""], ["green", "1", ""], ["red", "0", ""]):
+        ex = example_from_tokens(tokens, schema, encodings)
+        a = forest.predict(ex)
+        b = forest2.predict(ex)
+        assert a.get_most_probable_category_encoding() == \
+            b.get_most_probable_category_encoding()
+        np.testing.assert_allclose(a.category_probabilities,
+                                   b.category_probabilities, atol=1e-9)
+    # structural checks on the written XML
+    assert 'defaultChild="r++"' not in to_string(pmml)  # default is left
+    assert "weightedMajorityVote" in to_string(pmml)
+
+
+def test_pmml_round_trip_regression():
+    schema = InputSchema(from_dict({
+        "oryx.input-schema.feature-names": ["a", "b", "y"],
+        "oryx.input-schema.numeric-features": ["a", "b", "y"],
+        "oryx.input-schema.target-feature": "y"}))
+    encodings = CategoricalValueEncodings({})
+    root = DecisionNode(
+        "r", NumericDecision(0, 1.0, False),
+        TerminalNode("r-", NumericPrediction(-1.5, 4)),
+        TerminalNode("r+", NumericPrediction(2.5, 6)), count=10)
+    forest = DecisionForest([DecisionTree(root)], [1.0], [1.0, 0.0])
+    pmml = rdf_pmml.forest_to_pmml(forest, schema, encodings)
+    rdf_pmml.validate_pmml_vs_schema(pmml, schema)
+    forest2, _ = rdf_pmml.read_forest(from_string(to_string(pmml)))
+    ex = example_from_tokens(["2.0", "0", ""], schema, encodings)
+    assert forest2.predict(ex).prediction == pytest.approx(2.5)
+    assert forest2.trees[0].root.count == 10
+
+
+def test_validate_rejects_mismatches():
+    schema = _classification_schema()
+    forest = DecisionForest([_classification_tree()])
+    pmml = rdf_pmml.forest_to_pmml(forest, schema, _encodings())
+    other = InputSchema(from_dict({
+        "oryx.input-schema.feature-names": ["x", "y", "z"],
+        "oryx.input-schema.numeric-features": ["x", "y", "z"],
+        "oryx.input-schema.target-feature": "z"}))
+    with pytest.raises(ValueError):
+        rdf_pmml.validate_pmml_vs_schema(pmml, other)
+
+
+def test_forest_arrays_matches_host_walk():
+    schema = _classification_schema()
+    encodings = _encodings()
+    forest = DecisionForest([_classification_tree(),
+                             _classification_tree()])
+    arrays = ForestArrays(forest, schema.num_features, num_classes=3)
+    rng = np.random.default_rng(0)
+    examples = []
+    for _ in range(50):
+        color = None if rng.random() < 0.2 else int(rng.integers(0, 2))
+        size = None if rng.random() < 0.2 else float(rng.uniform(0, 4))
+        examples.append(Example(None, [color, size, None]))
+    x = examples_to_matrix(examples, schema.num_features)
+    probs = arrays.predict_proba(x)
+    ids = arrays.route_ids(x)
+    for i, ex in enumerate(examples):
+        expected = forest.predict(ex)
+        np.testing.assert_allclose(probs[i],
+                                   expected.category_probabilities,
+                                   atol=1e-6)
+        assert ids[0][i] == forest.trees[0].find_terminal(ex).id
+
+
+def test_forest_arrays_regression():
+    root = DecisionNode(
+        "r", NumericDecision(0, 0.0, False),
+        TerminalNode("r-", NumericPrediction(-1.0, 1)),
+        TerminalNode("r+", NumericPrediction(1.0, 1)))
+    forest = DecisionForest([DecisionTree(root)])
+    arrays = ForestArrays(forest, 1, num_classes=0)
+    out = arrays.predict_value(np.array([[-3.0], [4.0]], dtype=np.float32))
+    np.testing.assert_allclose(out, [-1.0, 1.0])

@@ -1,0 +1,90 @@
+"""Forest trainer tests: learn separable synthetic problems and check
+the model form (reference analog: RDFUpdateIT and MLlib-backed
+behavior asserted through accuracy rather than structure)."""
+
+import numpy as np
+import pytest
+
+from oryx_tpu.app.classreg import example_from_tokens
+from oryx_tpu.app.rdf.forest_arrays import ForestArrays
+from oryx_tpu.app.rdf.trainer import train_forest
+from oryx_tpu.app.schema import CategoricalValueEncodings, InputSchema
+from oryx_tpu.common.config import from_dict
+
+
+def _classification_schema():
+    return InputSchema(from_dict({
+        "oryx.input-schema.feature-names": ["a", "b", "color", "label"],
+        "oryx.input-schema.categorical-features": ["color", "label"],
+        "oryx.input-schema.target-feature": "label"}))
+
+
+def test_classification_forest_learns():
+    rng = np.random.default_rng(7)
+    n = 600
+    a = rng.uniform(-1, 1, n)
+    b = rng.uniform(-1, 1, n)
+    color = rng.integers(0, 3, n)
+    # label: 1 if a >= 0.2, else 0 unless color == 2 -> 1
+    y = np.where(a >= 0.2, 1, np.where(color == 2, 1, 0))
+    x = np.stack([a, b, color.astype(float)], axis=1).astype(np.float32)
+    schema = _classification_schema()
+    forest = train_forest(x, y, schema, category_counts={2: 3},
+                          num_trees=5, max_depth=4,
+                          max_split_candidates=16, impurity="gini",
+                          seed=123, num_classes=2)
+    assert len(forest.trees) == 5
+    arrays = ForestArrays(forest, schema.num_features, num_classes=2)
+    full = np.full((n, 4), np.nan, dtype=np.float32)
+    full[:, 0], full[:, 1], full[:, 2] = a, b, color
+    pred = arrays.predict_proba(full).argmax(axis=1)
+    accuracy = (pred == y).mean()
+    assert accuracy > 0.95
+    # importances: 'a' and 'color' should dominate over noise feature 'b'
+    imp = forest.feature_importances
+    assert imp[0] > imp[1]
+    assert imp.sum() == pytest.approx(1.0)
+    assert imp[3] == 0.0  # target has no importance
+    # record counts: root count equals the full training-set size
+    for tree in forest.trees:
+        assert tree.root.count == n or tree.root.is_terminal
+
+
+def test_regression_forest_learns():
+    rng = np.random.default_rng(3)
+    n = 500
+    a = rng.uniform(0, 4, n)
+    y = np.where(a < 2.0, 1.0, 5.0) + rng.normal(0, 0.05, n)
+    x = a[:, None].astype(np.float32)
+    schema = InputSchema(from_dict({
+        "oryx.input-schema.feature-names": ["a", "y"],
+        "oryx.input-schema.numeric-features": ["a", "y"],
+        "oryx.input-schema.target-feature": "y"}))
+    forest = train_forest(x, y, schema, category_counts={},
+                          num_trees=3, max_depth=3,
+                          max_split_candidates=32, impurity="variance",
+                          seed=5)
+    arrays = ForestArrays(forest, 2, num_classes=0)
+    test = np.array([[0.5, np.nan], [3.5, np.nan]], dtype=np.float32)
+    out = arrays.predict_value(test)
+    assert abs(out[0] - 1.0) < 0.3
+    assert abs(out[1] - 5.0) < 0.3
+
+
+def test_trainer_determinism_and_validation():
+    x = np.array([[0.0], [1.0], [2.0], [3.0]] * 10, dtype=np.float32)
+    y = np.array([0, 0, 1, 1] * 10)
+    schema = InputSchema(from_dict({
+        "oryx.input-schema.feature-names": ["a", "label"],
+        "oryx.input-schema.categorical-features": ["label"],
+        "oryx.input-schema.target-feature": "label"}))
+    f1 = train_forest(x, y, schema, {}, 2, 3, 8, "entropy", seed=9,
+                      num_classes=2)
+    f2 = train_forest(x, y, schema, {}, 2, 3, 8, "entropy", seed=9,
+                      num_classes=2)
+    for t1, t2 in zip(f1.trees, f2.trees):
+        assert [n.id for n in t1.nodes()] == [n.id for n in t2.nodes()]
+    with pytest.raises(ValueError):
+        train_forest(x, y, schema, {}, 2, 3, 8, "variance", seed=9)
+    with pytest.raises(ValueError):
+        train_forest(x, y, schema, {0: 100}, 2, 3, 8, "gini", seed=9)
