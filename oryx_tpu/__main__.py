@@ -1,0 +1,7 @@
+"""``python -m oryx_tpu``: the operator CLI (see deploy/main.py)."""
+
+import sys
+
+from .deploy.main import main
+
+sys.exit(main())

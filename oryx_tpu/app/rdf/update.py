@@ -55,11 +55,24 @@ class RDFUpdate(MLUpdate):
     # -- data prep ------------------------------------------------------------
 
     def _parse(self, data: Sequence[KeyMessage]) -> list[list[str]]:
-        """Tokenize, dropping unlabeled rows (empty target token, e.g.
-        to-be-predicted data that reached the input topic)."""
+        """Tokenize, dropping malformed rows (wrong token count would
+        otherwise poison every future generation, since generations
+        replay all past data) and unlabeled rows (empty target token,
+        e.g. to-be-predicted data that reached the input topic)."""
+        num = self.input_schema.num_features
         target = self.input_schema.target_feature_index
-        rows = [text_utils.parse_input_line(km.message) for km in data]
-        return [row for row in rows if row[target]]
+        out = []
+        bad = 0
+        for km in data:
+            row = text_utils.parse_input_line(km.message)
+            if len(row) != num:
+                bad += 1
+                continue
+            if row[target]:
+                out.append(row)
+        if bad:
+            _log.warning("Ignored %d rows with != %d tokens", bad, num)
+        return out
 
     def _encodings_from(self, rows) -> CategoricalValueEncodings:
         # distinct values per categorical feature, sorted for run-to-run

@@ -1,0 +1,4 @@
+"""Load/perf harnesses: synthetic model factory, /recommend load
+benchmark, and the standalone HTTP traffic generator (reference tier-4
+test strategy: LoadBenchmark.java, LoadTestALSModelFactory.java,
+TrafficUtil.java)."""

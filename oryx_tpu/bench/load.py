@@ -1,0 +1,130 @@
+"""Serving load benchmark: a synthetic ALS model served over live HTTP,
+driven by concurrent /recommend clients.
+
+Reference: app/oryx-app-serving/src/test/java/.../als/LoadBenchmark.java:65
+(opt-in benchmark profile: build a LoadTestALSModelFactory model with
+configurable users/items/features/lshSampleRate/workers, fire
+/recommend requests, log mean req time + heap) and
+LoadTestALSModelFactory.java:34.
+
+The factory sets vectors in bulk through the same set_user_vector /
+set_item_vector path the update-topic replay uses, so benchmarked state
+is the state production reaches.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+from ..app.als.serving_model import ALSServingModel
+from ..common.rand import RandomManager
+
+_log = logging.getLogger(__name__)
+
+__all__ = ["build_load_test_model", "LoadStats", "run_recommend_load"]
+
+
+def build_load_test_model(users: int = 10_000, items: int = 50_000,
+                          features: int = 50,
+                          lsh_sample_rate: float = 1.0,
+                          known_items_per_user: int = 9) -> ALSServingModel:
+    """Synthetic ALS serving model (reference:
+    LoadTestALSModelFactory.java:34 — default 2M x 9.7M x 250 on a
+    32-core box; scale down by default for laptop-class runs)."""
+    rng = RandomManager.random()
+    model = ALSServingModel(features, implicit=True,
+                            sample_rate=lsh_sample_rate)
+    t0 = time.time()
+    x = rng.standard_normal((users, features)).astype(np.float32)
+    y = rng.standard_normal((items, features)).astype(np.float32)
+    user_ids = [str(u) for u in range(users)]
+    item_ids = [str(i) for i in range(items)]
+    for u, uid in enumerate(user_ids):
+        model.set_user_vector(uid, x[u])
+        if known_items_per_user:
+            known = rng.integers(0, items, known_items_per_user)
+            model.add_known_items(uid, [item_ids[k] for k in known])
+    for i, iid in enumerate(item_ids):
+        model.set_item_vector(iid, y[i])
+    _log.info("Built load-test model %dx%dx%d in %.1fs",
+              users, items, features, time.time() - t0)
+    return model
+
+
+@dataclasses.dataclass
+class LoadStats:
+    requests: int
+    errors: int
+    elapsed_sec: float
+    latencies_ms: np.ndarray
+
+    @property
+    def qps(self) -> float:
+        return self.requests / self.elapsed_sec if self.elapsed_sec else 0.0
+
+    def percentile_ms(self, p: float) -> float:
+        return float(np.percentile(self.latencies_ms, p)) \
+            if len(self.latencies_ms) else float("nan")
+
+    def summary(self) -> dict:
+        return {
+            "requests": self.requests,
+            "errors": self.errors,
+            "qps": round(self.qps, 2),
+            "p50_ms": round(self.percentile_ms(50), 3),
+            "p95_ms": round(self.percentile_ms(95), 3),
+            "p99_ms": round(self.percentile_ms(99), 3),
+        }
+
+
+def run_recommend_load(base_url: str, user_ids: list[str],
+                       requests: int = 1000, workers: int = 4,
+                       how_many: int = 10,
+                       timeout_sec: float = 30.0) -> LoadStats:
+    """Drive GET /recommend/{user} with ``workers`` concurrent clients
+    (reference: LoadBenchmark.java uses ExecUtils.doInParallel over a
+    worker count; 1-3 concurrent requests saturate the scorer)."""
+    rng = RandomManager.random()
+    picks = rng.integers(0, len(user_ids), requests)
+    latencies: list[float] = []
+    errors = [0]
+    lock = threading.Lock()
+    next_index = [0]
+
+    def worker():
+        while True:
+            with lock:
+                i = next_index[0]
+                if i >= requests:
+                    return
+                next_index[0] += 1
+            url = (f"{base_url}/recommend/{user_ids[picks[i]]}"
+                   f"?howMany={how_many}")
+            start = time.perf_counter()
+            try:
+                with urllib.request.urlopen(url, timeout=timeout_sec) as r:
+                    r.read()
+                ms = (time.perf_counter() - start) * 1000.0
+                with lock:
+                    latencies.append(ms)
+            except Exception:
+                with lock:
+                    errors[0] += 1
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(workers)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t0
+    return LoadStats(requests=len(latencies), errors=errors[0],
+                     elapsed_sec=elapsed,
+                     latencies_ms=np.asarray(latencies))

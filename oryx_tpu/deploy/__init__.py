@@ -1,0 +1,2 @@
+"""Deploy tier: layer mains and the operator CLI (reference:
+deploy/oryx-{batch,speed,serving}/.../Main.java + deploy/bin/oryx-run.sh)."""

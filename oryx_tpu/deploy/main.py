@@ -1,0 +1,159 @@
+"""Operator CLI: run layers and manage topics.
+
+Reference: deploy/bin/oryx-run.sh:24-33 (subcommands batch | speed |
+serving | kafka-setup | kafka-tail | kafka-input), `--conf` config file
+(oryx-run.sh reads it via ConfigToProperties, here it's a HOCON overlay
+on the built-in defaults), and the three ~10-line Main classes
+(deploy/oryx-batch/.../batch/Main.java etc.: construct layer from
+config, register shutdown hook, start, await).
+
+Usage:
+    python -m oryx_tpu <subcommand> [--conf my.conf] ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+from ..common.config import Config, from_file, get_default
+from ..common.lang import ShutdownHook
+
+__all__ = ["main"]
+
+_log = logging.getLogger(__name__)
+
+
+def _load_config(conf: str | None) -> Config:
+    return from_file(conf) if conf else get_default()
+
+
+def _run_layer(layer) -> None:
+    hook = ShutdownHook()
+    hook.add_close_at_shutdown(layer)
+    layer.start()
+    try:
+        layer.await_()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        layer.close()
+
+
+def _cmd_batch(args) -> int:
+    from ..lambda_rt.batch import BatchLayer
+    _run_layer(BatchLayer(_load_config(args.conf)))
+    return 0
+
+
+def _cmd_speed(args) -> int:
+    from ..lambda_rt.speed import SpeedLayer
+    _run_layer(SpeedLayer(_load_config(args.conf)))
+    return 0
+
+
+def _cmd_serving(args) -> int:
+    from ..lambda_rt.serving import ServingLayer
+    _run_layer(ServingLayer(_load_config(args.conf)))
+    return 0
+
+
+def _topic_config(config: Config) -> list[tuple[str, str]]:
+    return [
+        (config.get_string("oryx.input-topic.broker"),
+         config.get_string("oryx.input-topic.message.topic")),
+        (config.get_string("oryx.update-topic.broker"),
+         config.get_string("oryx.update-topic.message.topic")),
+    ]
+
+
+def _cmd_kafka_setup(args) -> int:
+    from ..kafka import utils as kafka_utils
+    config = _load_config(args.conf)
+    for broker, topic in _topic_config(config):
+        kafka_utils.maybe_create_topic(broker, topic)
+        print(f"{topic} @ {broker}: "
+              f"{'exists' if kafka_utils.topic_exists(broker, topic) else 'missing'}")
+    return 0
+
+
+def _cmd_kafka_tail(args) -> int:
+    from ..kafka.inproc import resolve_broker
+    config = _load_config(args.conf)
+    consumers = [(topic, resolve_broker(broker), 0)
+                 for broker, topic in _topic_config(config)]
+    print("Tailing input and update topics; Ctrl-C to stop", file=sys.stderr)
+    try:
+        import time
+        offsets = {topic: 0 for topic, _, _ in consumers}
+        while True:
+            idle = True
+            for topic, broker, _ in consumers:
+                end = broker.latest_offset(topic)
+                for km in broker.read_range(topic, offsets[topic], end):
+                    print(f"{topic}\t{km.key}\t{km.message}")
+                    idle = False
+                offsets[topic] = end
+            if args.once and idle:
+                return 0
+            if idle:
+                time.sleep(0.5)
+    except KeyboardInterrupt:
+        return 0
+
+
+def _cmd_kafka_input(args) -> int:
+    from ..kafka.inproc import resolve_broker
+    config = _load_config(args.conf)
+    broker_uri = config.get_string("oryx.input-topic.broker")
+    topic = config.get_string("oryx.input-topic.message.topic")
+    broker = resolve_broker(broker_uri)
+    n = 0
+    source = open(args.file) if args.file else sys.stdin
+    try:
+        for line in source:
+            line = line.rstrip("\n")
+            if line:
+                broker.send(topic, None, line)
+                n += 1
+    finally:
+        if args.file:
+            source.close()
+    print(f"Sent {n} lines to {topic}", file=sys.stderr)
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="oryx_tpu",
+        description="TPU-native lambda-architecture ML framework")
+    parser.add_argument("--log-level", default="INFO")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    for name, fn, help_ in [
+            ("batch", _cmd_batch, "run the batch (training) layer"),
+            ("speed", _cmd_speed, "run the speed (incremental) layer"),
+            ("serving", _cmd_serving, "run the serving (REST) layer"),
+            ("kafka-setup", _cmd_kafka_setup, "create/check topics"),
+            ("kafka-tail", _cmd_kafka_tail, "print topic traffic"),
+            ("kafka-input", _cmd_kafka_input, "send lines to input topic")]:
+        p = sub.add_parser(name, help=help_)
+        p.add_argument("--conf", help="HOCON config file overlaying defaults")
+        p.set_defaults(fn=fn)
+        if name == "kafka-tail":
+            p.add_argument("--once", action="store_true",
+                           help="drain current contents and exit")
+        if name == "kafka-input":
+            p.add_argument("--file", help="read lines from a file "
+                                          "instead of stdin")
+
+    args = parser.parse_args(argv)
+    logging.basicConfig(
+        level=getattr(logging, args.log_level.upper(), logging.INFO),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    return args.fn(args)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,3 @@
+"""Word-count example custom app: the minimal end-to-end demonstration
+of the framework API (reference: app/example/ — a custom app counts,
+for each word, the distinct other words that co-occur on a line)."""

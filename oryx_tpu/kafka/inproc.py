@@ -76,46 +76,94 @@ def resolve_broker(broker_uri: str) -> "InProcBroker":
     """
     if broker_uri.startswith("memory://"):
         return get_broker(broker_uri[len("memory://"):] or "default")
+    if broker_uri.startswith("file://"):
+        # durable broker: topic logs live under the given directory, so
+        # separate processes (CLI kafka-input, batch, serving) share it
+        # the way the reference's layers share a real Kafka cluster
+        path = os.path.abspath(broker_uri[len("file://"):])
+        return get_broker(name=f"file:{path}", persist_dir=path)
     raise RuntimeError(
         f"Kafka-protocol broker {broker_uri!r} requested but no Kafka client "
-        "library is available in this environment; use a memory:// broker "
-        "or install kafka-python")
+        "library is available in this environment; use a memory:// or "
+        "file:// broker, or install kafka-python")
 
 
 class _Topic:
+    """One topic log.  When persisted, the on-disk JSONL file is the
+    source of truth shared BETWEEN processes: appends go through a raw
+    O_APPEND fd (one write syscall per record — atomic on a local fs,
+    so concurrent writers such as batch and speed never interleave a
+    record), and readers tail the file for records other processes
+    appended (``_refresh_locked``)."""
+
     def __init__(self, name: str, persist_path: str | None):
         self.name = name
         self.log: list[tuple[str | None, str]] = []
         self.cond = threading.Condition()
         self.persist_path = persist_path
-        self._fh = None
+        self._fd: int | None = None
+        self._read_pos = 0
+        self._tail = b""  # partial last line from a mid-record read
         if persist_path:
-            if os.path.exists(persist_path):
-                with open(persist_path, encoding="utf-8") as f:
-                    for line in f:
-                        if line.strip():
-                            k, m = json.loads(line)
-                            self.log.append((k, m))
-            # one long-lived line-buffered handle; not one open() per message
-            self._fh = open(persist_path, "a", encoding="utf-8", buffering=1)
+            self._fd = os.open(persist_path,
+                               os.O_APPEND | os.O_CREAT | os.O_WRONLY, 0o644)
+            with self.cond:
+                self._refresh_locked()
+
+    def _refresh_locked(self) -> None:
+        """Pull records appended by other processes into the in-memory
+        view.  Caller holds ``cond``."""
+        if self.persist_path is None:
+            return
+        try:
+            size = os.path.getsize(self.persist_path)
+        except OSError:
+            return
+        if size <= self._read_pos:
+            return
+        with open(self.persist_path, "rb") as f:
+            f.seek(self._read_pos)
+            chunk = self._tail + f.read()
+            self._read_pos = size
+        lines = chunk.split(b"\n")
+        self._tail = lines.pop()  # b"" unless the last record is partial
+        appended = False
+        for raw in lines:
+            if raw.strip():
+                k, m = json.loads(raw.decode("utf-8"))
+                self.log.append((k, m))
+                appended = True
+        if appended:
+            self.cond.notify_all()
 
     def append(self, key: str | None, message: str) -> int:
+        record = (json.dumps([key, message]) + "\n").encode("utf-8")
         with self.cond:
+            if self._fd is not None:
+                # the file is the source of truth: write, then re-read
+                # up to and past our record so in-memory offsets always
+                # reflect file order even with concurrent writers
+                os.write(self._fd, record)
+                self._refresh_locked()
+                return len(self.log) - 1
             self.log.append((key, message))
             offset = len(self.log) - 1
-            if self._fh is not None:
-                self._fh.write(json.dumps([key, message]) + "\n")
             self.cond.notify_all()
             return offset
 
+    def refresh(self) -> None:
+        with self.cond:
+            self._refresh_locked()
+
     def latest_offset(self) -> int:
         with self.cond:
+            self._refresh_locked()
             return len(self.log)
 
     def close(self) -> None:
-        if self._fh is not None:
-            self._fh.close()
-            self._fh = None
+        if self._fd is not None:
+            os.close(self._fd)
+            self._fd = None
 
 
 class InProcBroker:
@@ -163,7 +211,7 @@ class InProcBroker:
                     os.remove(t.persist_path)
             self._offsets = {k: v for k, v in self._offsets.items()
                              if k[1] != topic}
-            self._write_offsets_locked()
+            self._write_offsets_locked(drop_topic=topic)
 
     def _topic(self, topic: str) -> _Topic:
         with self._lock:
@@ -188,6 +236,7 @@ class InProcBroker:
             return []
         t = self._topic(topic)
         with t.cond:
+            t._refresh_locked()
             return [KeyMessage(k, m) for k, m in t.log[start:end]]
 
     def consume(self, topic: str, group: str | None = None,
@@ -223,6 +272,9 @@ class InProcBroker:
                                 and time.monotonic() - idle_since > max_idle_sec):
                             return
                         t.cond.wait(poll_timeout_sec)
+                        # appends from other processes sharing the
+                        # persisted log never signal our Condition
+                        t._refresh_locked()
                     key, message = t.log[pos]
                 pos += 1
                 idle_since = time.monotonic()
@@ -261,10 +313,27 @@ class InProcBroker:
                         >= _OFFSET_FLUSH_SEC):
                     self._write_offsets_locked()
 
-    def _write_offsets_locked(self) -> None:
+    def _write_offsets_locked(self, drop_topic: str | None = None) -> None:
         if self._offsets_path:
-            with open(self._offsets_path, "w", encoding="utf-8") as f:
-                json.dump({"\x00".join(k): v for k, v in self._offsets.items()}, f)
+            # merge with on-disk entries so processes sharing the broker
+            # dir don't clobber each other's consumer-group commits —
+            # each process only advances the groups it consumes as
+            merged: dict[tuple[str, str], int] = {}
+            if os.path.exists(self._offsets_path):
+                try:
+                    with open(self._offsets_path, encoding="utf-8") as f:
+                        merged = {tuple(k.split("\x00", 1)): v  # type: ignore[misc]
+                                  for k, v in json.load(f).items()}
+                except (OSError, ValueError):
+                    pass
+            merged.update(self._offsets)
+            if drop_topic is not None:
+                merged = {k: v for k, v in merged.items()
+                          if k[1] != drop_topic}
+            tmp = self._offsets_path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump({"\x00".join(k): v for k, v in merged.items()}, f)
+            os.replace(tmp, self._offsets_path)
             self._offsets_dirty_since = None
             self._offsets_last_write = time.monotonic()
 
@@ -272,6 +341,15 @@ class InProcBroker:
         with self._lock:
             if self._offsets_dirty_since is not None:
                 self._write_offsets_locked()
+
+    def close(self) -> None:
+        """Flush offsets and release topic log file handles (used when a
+        durable broker is handed between processes)."""
+        with self._lock:
+            if self._offsets_dirty_since is not None:
+                self._write_offsets_locked()
+            for topic in self._topics.values():
+                topic.close()
 
     def fill_in_latest_offsets(self, group: str, topics: list[str]) -> None:
         """For any topic without a committed offset, commit the latest —

@@ -1,0 +1,74 @@
+"""Load-bench harness tests (reference: LoadBenchmark runs as an
+opt-in profile; here a scaled-down smoke run is part of the suite)."""
+
+import numpy as np
+import pytest
+
+from oryx_tpu.bench.load import build_load_test_model, run_recommend_load
+from oryx_tpu.bench.traffic import ALS_ENDPOINTS, EndpointMix, run_traffic
+from oryx_tpu.common.config import from_dict
+from oryx_tpu.lambda_rt.serving import ServingLayer
+
+
+class LoadMockManager:
+    model = None
+
+    def __init__(self, config):
+        pass
+
+    def get_model(self):
+        return LoadMockManager.model
+
+    def get_config(self):
+        return None
+
+    def is_read_only(self):
+        return True
+
+    def consume(self, updates):
+        pass
+
+    def close(self):
+        pass
+
+
+@pytest.fixture(scope="module")
+def load_server():
+    LoadMockManager.model = build_load_test_model(
+        users=200, items=500, features=8, known_items_per_user=3)
+    cfg = from_dict({
+        "oryx.serving.model-manager-class":
+            "tests.test_bench_load.LoadMockManager",
+        "oryx.serving.application-resources": "oryx_tpu.serving.als",
+        "oryx.input-topic.broker": None,
+        "oryx.input-topic.message.topic": None,
+        "oryx.update-topic.broker": None,
+        "oryx.update-topic.message.topic": None,
+    })
+    layer = ServingLayer(cfg, port=0)
+    layer.start()
+    yield layer
+    layer.close()
+
+
+def test_recommend_load(load_server):
+    base = f"http://127.0.0.1:{load_server.port}"
+    user_ids = [str(u) for u in range(200)]
+    stats = run_recommend_load(base, user_ids, requests=50, workers=3)
+    assert stats.errors == 0
+    assert stats.requests == 50
+    assert stats.qps > 0
+    assert np.isfinite(stats.percentile_ms(50))
+    summary = stats.summary()
+    assert set(summary) == {"requests", "errors", "qps", "p50_ms",
+                            "p95_ms", "p99_ms"}
+
+
+def test_traffic_generator(load_server):
+    base = f"http://127.0.0.1:{load_server.port}"
+    mix = EndpointMix(ALS_ENDPOINTS, users=200, items=500)
+    stats = run_traffic([base], mix, mean_qps=100.0, duration_sec=1.5,
+                        workers=3)
+    assert stats.requests + stats.errors > 0
+    # estimates for random ids can 404/503-free: all mix endpoints exist
+    assert stats.errors == 0

@@ -1,0 +1,105 @@
+"""Deploy CLI + example conf tests (reference: deploy/bin/oryx-run.sh
+subcommands, deploy Main classes, app/conf/*.conf)."""
+
+import glob
+import os
+
+import pytest
+
+from oryx_tpu.common.config import from_file
+from oryx_tpu.deploy.main import main
+from oryx_tpu.kafka import inproc
+
+
+def test_shipped_conf_files_parse():
+    confs = glob.glob(os.path.join(os.path.dirname(__file__), "..",
+                                   "conf", "*.conf"))
+    assert len(confs) >= 4
+    for path in confs:
+        cfg = from_file(path)
+        # substitutions resolved and defaults overlaid
+        assert cfg.get_string("oryx.input-topic.broker") == \
+            cfg.get_string("oryx.update-topic.broker")
+        assert cfg.get_string("oryx.serving.model-manager-class")
+        assert cfg.get_int("oryx.serving.api.port") == 8080
+
+
+def _write_conf(tmp_path, broker_uri):
+    conf = tmp_path / "app.conf"
+    conf.write_text(f"""
+oryx {{
+  input-topic.broker = "{broker_uri}"
+  update-topic.broker = "{broker_uri}"
+  input-topic.message.topic = "CliIn"
+  update-topic.message.topic = "CliUp"
+}}
+""")
+    return str(conf)
+
+
+def test_cli_kafka_commands(tmp_path, capsys):
+    broker_uri = f"file://{tmp_path}/broker"
+    conf = _write_conf(tmp_path, broker_uri)
+
+    assert main(["kafka-setup", "--conf", conf]) == 0
+    out = capsys.readouterr().out
+    assert "CliIn" in out and "exists" in out
+
+    data = tmp_path / "lines.csv"
+    data.write_text("u1,i1,1.0\nu2,i2,2.0\n\n")
+    assert main(["kafka-input", "--conf", conf,
+                 "--file", str(data)]) == 0
+
+    assert main(["kafka-tail", "--once", "--conf", conf]) == 0
+    out = capsys.readouterr().out
+    assert "u1,i1,1.0" in out and "u2,i2,2.0" in out
+
+
+def test_file_broker_survives_process_restart(tmp_path):
+    broker_uri = f"file://{tmp_path}/durable"
+    broker = inproc.resolve_broker(broker_uri)
+    broker.send("T", "K", "hello")
+    broker.set_offset("g", "T", 1)
+    broker.flush()
+    name = broker.name
+    # simulate a new process: drop the in-memory registry entry
+    with inproc._REGISTRY_LOCK:
+        inproc._REGISTRY.pop(name).close()
+    reloaded = inproc.resolve_broker(broker_uri)
+    msgs = reloaded.read_range("T", 0, reloaded.latest_offset("T"))
+    assert [(m.key, m.message) for m in msgs] == [("K", "hello")]
+    assert reloaded.get_offset("g", "T") == 1
+
+
+def test_cli_rejects_unknown_command():
+    with pytest.raises(SystemExit):
+        main(["no-such-command"])
+
+
+def test_file_broker_live_between_processes(tmp_path):
+    """A consumer in THIS process must see records another live process
+    appends to the shared file:// broker (tailing, not just reload)."""
+    import subprocess
+    import sys
+
+    broker_uri = f"file://{tmp_path}/live"
+    conf = _write_conf(tmp_path, broker_uri)
+    broker = inproc.resolve_broker(broker_uri)
+    assert broker.latest_offset("CliIn") == 0
+
+    data = tmp_path / "lines.csv"
+    data.write_text("x1,y1,1.0\nx2,y2,2.0\n")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": os.path.dirname(os.path.dirname(__file__))}
+    subprocess.run(
+        [sys.executable, "-m", "oryx_tpu", "kafka-input",
+         "--conf", conf, "--file", str(data)],
+        check=True, env=env, timeout=120)
+
+    # same broker object, no restart: tail picks the records up
+    msgs = list(broker.consume("CliIn", from_beginning=True,
+                               max_idle_sec=1.0))
+    assert [m.message for m in msgs] == ["x1,y1,1.0", "x2,y2,2.0"]
+    # and offsets committed by this process merge with the file
+    broker.set_offset("g2", "CliIn", 2)
+    broker.flush()

@@ -1,0 +1,90 @@
+"""Word-count example app tests (reference: ExampleBatchLayerUpdateIT,
+ExampleSpeedIT, ExampleServingIT): the custom-app API demonstration
+running through the real layers."""
+
+import json
+import time
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from oryx_tpu.common.config import from_dict
+from oryx_tpu.example.batch import (ExampleBatchLayerUpdate,
+                                    count_distinct_other_words)
+from oryx_tpu.example.speed import ExampleSpeedModelManager
+from oryx_tpu.kafka.api import KEY_MODEL, KeyMessage
+from oryx_tpu.kafka.inproc import get_broker
+from oryx_tpu.lambda_rt.batch import BatchLayer
+from oryx_tpu.lambda_rt.serving import ServingLayer
+
+
+def test_count_distinct_other_words():
+    data = [KeyMessage(None, "a b c"), KeyMessage(None, "a b"),
+            KeyMessage(None, "a a b")]
+    counts = count_distinct_other_words(data)
+    # a co-occurs with b and c; b with a and c; c with a and b
+    assert counts == {"a": 2, "b": 2, "c": 2}
+
+
+def test_speed_manager_accumulates():
+    mgr = ExampleSpeedModelManager(from_dict({}))
+    mgr.consume_key_message(KEY_MODEL, json.dumps({"a": 1}))
+    ups = sorted(mgr.build_updates([KeyMessage(None, "a b")]))
+    assert ups == ["a,2", "b,1"]
+
+
+def test_example_full_loop(tmp_path):
+    cfg = from_dict({
+        "oryx.id": "ex",
+        "oryx.input-topic.broker": "memory://ex-it",
+        "oryx.input-topic.message.topic": "ExIn",
+        "oryx.update-topic.broker": "memory://ex-it",
+        "oryx.update-topic.message.topic": "ExUp",
+        "oryx.batch.update-class":
+            "oryx_tpu.example.batch.ExampleBatchLayerUpdate",
+        "oryx.serving.model-manager-class":
+            "oryx_tpu.example.serving.ExampleServingModelManager",
+        "oryx.serving.application-resources": "oryx_tpu.example.serving",
+        "oryx.batch.storage.data-dir": str(tmp_path / "data"),
+        "oryx.batch.storage.model-dir": str(tmp_path / "model"),
+    })
+    broker = get_broker("ex-it")
+    serving = ServingLayer(cfg, port=0)
+    serving.start()
+    base = None
+    try:
+        base = f"http://127.0.0.1:{serving.port}"
+        # /add writes the input topic (no model needed yet)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            try:
+                urllib.request.urlopen(urllib.request.Request(
+                    f"{base}/add/" + urllib.parse.quote("cats and dogs"), data=b"", method="POST"),
+                    timeout=2)
+                break
+            except urllib.error.URLError:
+                time.sleep(0.1)
+        urllib.request.urlopen(urllib.request.Request(
+            f"{base}/add/" + urllib.parse.quote("cats are great"), data=b"", method="POST"),
+            timeout=5)
+        assert broker.latest_offset("ExIn") == 2
+
+        BatchLayer(cfg).run_one_generation()
+        deadline = time.time() + 10
+        words = None
+        while time.time() < deadline:
+            words = json.loads(urllib.request.urlopen(
+                f"{base}/distinct", timeout=5).read())
+            if words:
+                break
+            time.sleep(0.2)
+        assert words["cats"] == 4  # and, dogs, are, great
+        one = json.loads(urllib.request.urlopen(
+            f"{base}/distinct/dogs", timeout=5).read())
+        assert one == 2
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(f"{base}/distinct/zebra", timeout=5)
+        assert e.value.code == 400
+    finally:
+        serving.close()
