@@ -87,6 +87,8 @@ class ALSUpdate(MLUpdate):
             raise ValueError("decay factor must be in (0,1]")
         if self.decay_zero_threshold < 0.0:
             raise ValueError("decay zero threshold must be >= 0")
+        from ...parallel.mesh import mesh_from_config
+        self.mesh = mesh_from_config(config)
         self._hyper_params = [
             hp.from_config(config, "oryx.als.hyperparams.features"),
             hp.from_config(config, "oryx.als.hyperparams.lambda"),
@@ -112,8 +114,14 @@ class ALSUpdate(MLUpdate):
                                          self.decay_zero_threshold)
         ratings = als_common.aggregate(events, self.implicit,
                                        self.log_strength, epsilon)
-        model = train_als(ratings, features, lam, alpha, self.implicit,
-                          self.iterations)
+        if self.mesh is not None:
+            from ...parallel.als_dist import train_als_distributed
+            model = train_als_distributed(ratings, features, lam, alpha,
+                                          self.implicit, self.iterations,
+                                          self.mesh)
+        else:
+            model = train_als(ratings, features, lam, alpha, self.implicit,
+                              self.iterations)
         return self._model_to_pmml(model, features, lam, alpha, epsilon,
                                    candidate_path)
 

@@ -51,6 +51,8 @@ class KMeansUpdate(MLUpdate):
         if self.evaluation_strategy not in evaluation.EVAL_STRATEGIES:
             raise ValueError(
                 f"bad evaluation-strategy: {self.evaluation_strategy}")
+        from ...parallel.mesh import mesh_from_config
+        self.mesh = mesh_from_config(config)
         # unsupervised, numeric-only problem
         if self.input_schema.has_target():
             raise ValueError("k-means does not take a target feature")
@@ -78,8 +80,14 @@ class KMeansUpdate(MLUpdate):
             return None
         _log.info("Building KMeans model with %d clusters over %d points",
                   k, len(points))
-        clusters = train_kmeans(points, k, self.iterations, self.runs,
-                                self.initialization_strategy)
+        if self.mesh is not None:
+            from ...parallel.kmeans_dist import train_kmeans_distributed
+            clusters = train_kmeans_distributed(
+                points, k, self.iterations, self.mesh, self.runs,
+                self.initialization_strategy)
+        else:
+            clusters = train_kmeans(points, k, self.iterations, self.runs,
+                                    self.initialization_strategy)
         return kmeans_pmml.clusters_to_pmml(clusters, self.input_schema)
 
     def evaluate(self, model: Element, candidate_path: str,

@@ -34,7 +34,7 @@ from __future__ import annotations
 
 import logging
 import math
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -56,8 +56,8 @@ IMPURITIES = ("gini", "entropy", "variance")
 
 # -- device kernels -----------------------------------------------------------
 
-@partial(jax.jit, static_argnums=(4, 5))
-def _histograms(binned, ychan, w, slot_of, num_slots: int, num_bins: int):
+def _histogram_body(binned, ychan, w, slot_of, num_slots: int,
+                    num_bins: int):
     """Weighted per-(tree, slot, predictor, bin) stats.
 
     binned:  [B, P] int32   pre-binned predictor values
@@ -86,6 +86,29 @@ def _histograms(binned, ychan, w, slot_of, num_slots: int, num_bins: int):
     # lax.map (not vmap) over trees: bounds peak memory at one tree's
     # [B, P, C] contribution tensor
     return jax.lax.map(lambda args: per_tree(*args), (w, slot_of))
+
+
+_histograms = partial(jax.jit, static_argnums=(4, 5))(_histogram_body)
+
+
+@lru_cache(maxsize=64)
+def _dist_histograms_fn(mesh, axis: str, num_slots: int, num_bins: int):
+    """Data-parallel histograms over a device mesh: examples are
+    row-sharded, each device aggregates its shard's stats, and one
+    psum over ICI replaces MLlib's node-stats shuffle.  The replicated
+    result feeds the (cheap) split scan identically on every device."""
+    from jax.sharding import PartitionSpec as P
+
+    def inner(binned, ychan, w, slot_of):
+        local = _histogram_body(binned, ychan, w, slot_of,
+                                num_slots, num_bins)
+        return jax.lax.psum(local, axis)
+
+    return jax.jit(jax.shard_map(
+        inner, mesh=mesh,
+        in_specs=(P(axis, None), P(axis, None), P(None, axis),
+                  P(None, axis)),
+        out_specs=P()))
 
 
 def _impurity(stats, kind: str):
@@ -172,9 +195,8 @@ def _best_splits(hist, is_cat_p, feat_mask, impurity: str, k_features: int):
     return best_gain, best_p, best_b, default_right, right_mask, totals
 
 
-@jax.jit
-def _advance(slot_of, binned, split, best_p, best_b, is_cat_slot,
-             right_mask, child_slots):
+def _advance_body(slot_of, binned, split, best_p, best_b, is_cat_slot,
+                  right_mask, child_slots):
     """Route samples to child slots (or settle them at leaves).
 
     slot_of [T, B], binned [B, P], split/best_p/best_b/is_cat_slot
@@ -196,6 +218,20 @@ def _advance(slot_of, binned, split, best_p, best_b, is_cat_slot,
 
     return jax.vmap(per_tree)(slot_of, split, best_p, best_b, is_cat_slot,
                               right_mask, child_slots)
+
+
+_advance = jax.jit(_advance_body)
+
+
+@lru_cache(maxsize=16)
+def _dist_advance_fn(mesh, axis: str):
+    """Sharded routing step: purely per-sample, no collectives."""
+    from jax.sharding import PartitionSpec as P
+
+    return jax.jit(jax.shard_map(
+        _advance_body, mesh=mesh,
+        in_specs=(P(None, axis), P(axis, None)) + (P(),) * 6,
+        out_specs=P(None, axis)))
 
 
 # -- binning ------------------------------------------------------------------
@@ -222,11 +258,15 @@ def train_forest(x: np.ndarray, y: np.ndarray, schema: InputSchema,
                  category_counts: dict[int, int], num_trees: int,
                  max_depth: int, max_split_candidates: int,
                  impurity: str, seed: int | None = None,
-                 num_classes: int | None = None) -> DecisionForest:
+                 num_classes: int | None = None,
+                 mesh=None, mesh_axis: str = "d") -> DecisionForest:
     """Train a forest on predictors ``x`` [B, P] (categorical values as
     encodings) and targets ``y`` (class encodings or regression values).
 
     ``category_counts`` maps predictor index -> number of categories.
+    With ``mesh``, examples are sharded over the mesh axis and the
+    per-level histogram reduction runs as a psum over ICI (data
+    parallelism; split selection replicates).
     """
     if impurity not in IMPURITIES:
         raise ValueError(f"bad impurity: {impurity}")
@@ -269,6 +309,25 @@ def train_forest(x: np.ndarray, y: np.ndarray, schema: InputSchema,
     w = jax.random.poisson(key, 1.0, (num_trees, batch)).astype(jnp.float32)
 
     slot_of = jnp.zeros((num_trees, batch), dtype=jnp.int32)
+
+    if mesh is not None:
+        # pad the example axis to the mesh size; padding rows have
+        # weight 0 and slot -1, so they never contribute
+        n_dev = mesh.devices.size
+        pad = (-batch) % n_dev
+        if pad:
+            binned = jnp.pad(binned, ((0, pad), (0, 0)))
+            ychan = jnp.pad(ychan, ((0, pad), (0, 0)))
+            w = jnp.pad(w, ((0, 0), (0, pad)))
+            slot_of = jnp.pad(slot_of, ((0, 0), (0, pad)),
+                              constant_values=-1)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        row = NamedSharding(mesh, P(mesh_axis))
+        col = NamedSharding(mesh, P(None, mesh_axis))
+        binned = jax.device_put(binned, row)
+        ychan = jax.device_put(jnp.asarray(ychan), row)
+        w = jax.device_put(w, col)
+        slot_of = jax.device_put(slot_of, col)
     # per-(tree, slot) node-ID strings for the current frontier
     frontier_ids = [["r"] for _ in range(num_trees)]
     # per-tree accumulated node records: id -> dict
@@ -280,7 +339,12 @@ def train_forest(x: np.ndarray, y: np.ndarray, schema: InputSchema,
         num_slots = max(len(ids) for ids in frontier_ids)
         if num_slots == 0:
             break
-        hist = _histograms(binned, ychan, w, slot_of, num_slots, num_bins)
+        if mesh is not None:
+            hist = _dist_histograms_fn(mesh, mesh_axis, num_slots,
+                                       num_bins)(binned, ychan, w, slot_of)
+        else:
+            hist = _histograms(binned, ychan, w, slot_of, num_slots,
+                               num_bins)
         feat_u = jax.random.uniform(
             jax.random.fold_in(key, depth + 1),
             (num_trees, num_slots, num_p))
@@ -328,9 +392,11 @@ def train_forest(x: np.ndarray, y: np.ndarray, schema: InputSchema,
 
         if not any(next_ids[t] for t in range(num_trees)):
             break
-        slot_of = _advance(slot_of, binned, jnp.asarray(split_np),
-                           best_p, best_b, jnp.asarray(is_cat_slot),
-                           right_mask, jnp.asarray(child_slots))
+        advance = _advance if mesh is None \
+            else _dist_advance_fn(mesh, mesh_axis)
+        slot_of = advance(slot_of, binned, jnp.asarray(split_np),
+                          best_p, best_b, jnp.asarray(is_cat_slot),
+                          right_mask, jnp.asarray(child_slots))
         frontier_ids = next_ids
 
     forest = _build_forest(records, schema, classification,

@@ -48,6 +48,8 @@ class RDFUpdate(MLUpdate):
         self.input_schema = InputSchema(config)
         if not self.input_schema.has_target():
             raise ValueError("rdf requires a target feature")
+        from ...parallel.mesh import mesh_from_config
+        self.mesh = mesh_from_config(config)
 
     def get_hyper_parameter_values(self):
         return self.hyper_param_values
@@ -138,7 +140,7 @@ class RDFUpdate(MLUpdate):
         forest = train_forest(x, y, schema, category_counts,
                               self.num_trees, max_depth,
                               max_split_candidates, impurity,
-                              num_classes=num_classes)
+                              num_classes=num_classes, mesh=self.mesh)
         return rdf_pmml.forest_to_pmml(
             forest, schema, encodings, max_depth=max_depth,
             max_split_candidates=max_split_candidates, impurity=impurity)

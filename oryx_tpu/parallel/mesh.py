@@ -34,3 +34,27 @@ def build_mesh(n_devices: int | None = None, axis: str = "d") -> Mesh:
 def local_mesh(axis: str = "d") -> Mesh:
     """Mesh over every device JAX can see (single-host: all local chips)."""
     return build_mesh(None, axis)
+
+
+def mesh_from_config(config, axis: str = "d") -> Mesh | None:
+    """The batch layer's training mesh, or None for single-device.
+
+    ``oryx.batch.streaming.num-executors x executor-cores`` is the
+    requested total device count (the reference's executor sizing,
+    reference.conf:146-150 / oryx-run.sh:160-231, re-read as chips);
+    the mesh shrinks to the devices actually present.
+    """
+    master = config.get_string("oryx.batch.streaming.master")
+    if master == "cpu":
+        return None
+    if jax.default_backend() == "cpu" and master != "mesh":
+        # "auto" on a CPU backend: virtual host devices exist only for
+        # sharding tests; single-device XLA is faster for real work.
+        # master = "mesh" forces a mesh over them (tests, dry runs).
+        return None
+    requested = (config.get_int("oryx.batch.streaming.num-executors")
+                 * config.get_int("oryx.batch.streaming.executor-cores"))
+    n = min(requested, len(jax.devices()))
+    if n <= 1:
+        return None
+    return build_mesh(n, axis)
