@@ -145,12 +145,14 @@ class ALSServingModel(FactorModelBase, ServingModel):
               exclude: Iterable[str] = (),
               rescorer: Rescorer | None = None,
               allowed: Callable[[str], bool] | None = None,
-              lowest: bool = False) -> list[tuple[str, float]]:
+              lowest: bool = False,
+              use_lsh: bool = True) -> list[tuple[str, float]]:
         """Top (or bottom, with ``lowest``) scoring items with scores.
 
         Exactly one of ``user_vector`` (dot-product scores, the
         reference's DotsFunction) or ``cosine_to`` (mean-cosine scores,
-        CosineAverageFunction) selects the kernel.
+        CosineAverageFunction) selects the kernel.  ``use_lsh=False``
+        forces an exact scan even on an LSH-configured model.
         """
         vecs, active, version = self.Y.device_arrays_versioned()
         if user_vector is not None:
@@ -165,7 +167,8 @@ class ALSServingModel(FactorModelBase, ServingModel):
             lsh_query = V.mean(axis=1)
         if lowest:
             scores = -scores
-        mask = self._lsh_mask(lsh_query, vecs, version, active)
+        mask = self._lsh_mask(lsh_query if use_lsh else None, vecs, version,
+                              active)
 
         exclude = set(exclude)
         if rescorer is not None or allowed is not None:
@@ -191,28 +194,47 @@ class ALSServingModel(FactorModelBase, ServingModel):
                                     how_many, exclude, None, None, lowest)
         return out
 
-    def top_n_batch(self, how_many: int, user_vectors: np.ndarray,
+    def top_n_batch(self, how_many: int | Sequence[int],
+                    user_vectors: np.ndarray,
                     exclude: Sequence[Iterable[str]] | None = None
                     ) -> list[list[tuple[str, float]]]:
         """Batched exact top-N: one device dispatch for a whole batch of
         /recommend requests.  ``user_vectors`` is (B, features);
+        ``how_many`` is one size for all requests or one per request;
         ``exclude`` optionally gives per-request excluded item IDs.
-        Rescorers/allowed-predicates take the single-request path."""
+        Rescorers/allowed-predicates take the single-request path.
+
+        The batch dimension is zero-padded up to a power of two so the
+        request micro-batcher's varying drain sizes hit a handful of
+        compiled shapes instead of one XLA program per batch size."""
         Q = np.asarray(user_vectors, dtype=np.float32)
         if Q.ndim != 2 or Q.shape[1] != self.features:
             raise ValueError("user_vectors must be (B, features)")
+        n_req = Q.shape[0]
+        if n_req == 0:
+            return []
+        hm = [how_many] * n_req if isinstance(how_many, int) \
+            else list(how_many)
+        if len(hm) != n_req:
+            raise ValueError("one how_many per user vector required")
         excl = [set(e) for e in exclude] if exclude is not None \
-            else [set()] * Q.shape[0]
+            else [set()] * n_req
         vecs, active, _ = self.Y.device_arrays_versioned()
-        max_excl = max((len(e) for e in excl), default=0)
-        k = min(_pad_k(how_many + max_excl), int(vecs.shape[0]))
+        k = min(_pad_k(max(h + len(e) for h, e in zip(hm, excl))),
+                int(vecs.shape[0]))
+        # floor of 8: a (1,F)x(F,N) matvec hits a much slower XLA path
+        # than a small batched matmul, and zero rows are free
+        b_pad = 1 << max(3, (n_req - 1).bit_length())
+        if b_pad != n_req:
+            Q = np.concatenate(
+                [Q, np.zeros((b_pad - n_req, Q.shape[1]), np.float32)])
         # fetch both outputs in ONE host round-trip (matters when the
         # device sits behind a high-latency transport)
         top_scores, top_idx = jax.device_get(
             _batch_top_n_kernel(vecs, jnp.asarray(Q), active, k))
         row_ids = self.Y.row_ids()
         results: list[list[tuple[str, float]]] = []
-        for b in range(Q.shape[0]):
+        for b in range(n_req):
             out: list[tuple[str, float]] = []
             for s, i in zip(top_scores[b].tolist(), top_idx[b].tolist()):
                 if not math.isfinite(s):
@@ -221,8 +243,13 @@ class ALSServingModel(FactorModelBase, ServingModel):
                 if id_ is None or id_ in excl[b]:
                     continue
                 out.append((id_, s))
-                if len(out) == how_many:
+                if len(out) == hm[b]:
                     break
+            if len(out) < hm[b] and k < int(vecs.shape[0]):
+                # this request's exclusions ate its window; redo exactly
+                # (no LSH mask — the batch path is an exact scan)
+                out = self.top_n(hm[b], user_vector=user_vectors[b],
+                                 exclude=excl[b], use_lsh=False)
             results.append(out)
         return results
 

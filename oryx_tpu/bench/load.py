@@ -15,19 +15,42 @@ is the state production reaches.
 from __future__ import annotations
 
 import dataclasses
+import http.client
 import logging
 import threading
 import time
-import urllib.request
+import urllib.parse
 
 import numpy as np
 
+from ..api.serving import ServingModelManager
 from ..app.als.serving_model import ALSServingModel
 from ..common.rand import RandomManager
 
 _log = logging.getLogger(__name__)
 
-__all__ = ["build_load_test_model", "LoadStats", "run_recommend_load"]
+__all__ = ["StaticModelManager", "build_load_test_model", "LoadStats",
+           "run_recommend_load"]
+
+
+class StaticModelManager(ServingModelManager):
+    """Read-only manager serving a prebuilt model, for load benches and
+    endpoint tests (reference test scope: MockServingModelManager.java:27).
+    Subclass per test and set the ``model`` class attribute."""
+
+    model = None
+
+    def __init__(self, config=None):
+        pass
+
+    def consume(self, updates) -> None:
+        pass
+
+    def get_model(self):
+        return type(self).model
+
+    def is_read_only(self) -> bool:
+        return True
 
 
 def build_load_test_model(users: int = 10_000, items: int = 50_000,
@@ -96,26 +119,40 @@ def run_recommend_load(base_url: str, user_ids: list[str],
     errors = [0]
     lock = threading.Lock()
     next_index = [0]
+    parsed = urllib.parse.urlparse(base_url)
+    host, port = parsed.hostname, parsed.port
+    path_prefix = parsed.path.rstrip("/")
 
     def worker():
-        while True:
-            with lock:
-                i = next_index[0]
-                if i >= requests:
-                    return
-                next_index[0] += 1
-            url = (f"{base_url}/recommend/{user_ids[picks[i]]}"
-                   f"?howMany={how_many}")
-            start = time.perf_counter()
-            try:
-                with urllib.request.urlopen(url, timeout=timeout_sec) as r:
-                    r.read()
+        # one persistent keep-alive connection per worker: measures the
+        # request path, not TCP handshakes and server thread churn
+        conn = http.client.HTTPConnection(host, port, timeout=timeout_sec)
+        try:
+            while True:
+                with lock:
+                    i = next_index[0]
+                    if i >= requests:
+                        return
+                    next_index[0] += 1
+                path = (f"{path_prefix}/recommend/{user_ids[picks[i]]}"
+                        f"?howMany={how_many}")
+                start = time.perf_counter()
+                try:
+                    conn.request("GET", path)
+                    resp = conn.getresponse()
+                    resp.read()
+                    ok = resp.status == 200
+                except Exception:
+                    ok = False
+                    conn.close()  # reconnect on next request
                 ms = (time.perf_counter() - start) * 1000.0
                 with lock:
-                    latencies.append(ms)
-            except Exception:
-                with lock:
-                    errors[0] += 1
+                    if ok:
+                        latencies.append(ms)
+                    else:
+                        errors[0] += 1
+        finally:
+            conn.close()
 
     threads = [threading.Thread(target=worker, daemon=True)
                for _ in range(workers)]

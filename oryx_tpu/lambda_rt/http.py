@@ -270,6 +270,11 @@ def make_server(app: HttpApp, port: int) -> ThreadingHTTPServer:
         def do_DELETE(self):
             app.handle(self)
 
-    server = ThreadingHTTPServer(("0.0.0.0", port), _Handler)
-    server.daemon_threads = True
-    return server
+    class _Server(ThreadingHTTPServer):
+        daemon_threads = True
+        # hundreds of concurrent keep-alive clients (reference connector
+        # allows 400 threads, ServingLayer.java:235); the socketserver
+        # default backlog of 5 refuses connections under load
+        request_queue_size = 512
+
+    return _Server(("0.0.0.0", port), _Handler)

@@ -19,6 +19,7 @@ from ..common.config import Config
 from ..common.lang import load_instance, logging_call
 from ..kafka import utils as kafka_utils
 from ..kafka.inproc import InProcTopicProducer, resolve_broker
+from ..serving.batcher import TopNBatcher
 from .http import HttpApp, Route, make_server
 
 _log = logging.getLogger(__name__)
@@ -61,6 +62,7 @@ class ServingLayer:
                                                       self.input_topic)
 
         routes = self._discover_routes()
+        self.top_n_batcher = TopNBatcher()
         self.app = HttpApp(
             routes,
             context={
@@ -68,6 +70,7 @@ class ServingLayer:
                 "input_producer": self.input_producer,
                 "config": config,
                 "min_model_load_fraction": self.min_model_load_fraction,
+                "top_n_batcher": self.top_n_batcher,
             },
             read_only=self.read_only,
             user_name=self.user_name,
@@ -127,6 +130,7 @@ class ServingLayer:
         self._stop.set()
         if self._server:
             self._server.shutdown()
+        self.top_n_batcher.close()
         self.model_manager.close()
         if self.input_producer:
             self.input_producer.close()

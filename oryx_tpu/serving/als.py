@@ -121,6 +121,19 @@ def _rescorer(model: ALSServingModel, hook: str, req: Request, *args):
     return getattr(provider, hook)(*args, req.q_list("rescorerParams"))
 
 
+def _dot_top_n(req: Request, model: ALSServingModel, how_many: int,
+               user_vector: np.ndarray, exclude: set[str],
+               rescorer) -> list[tuple[str, float]]:
+    """Dot-product top-N, coalesced with concurrent requests through the
+    app-scope TopNBatcher when exact-scan semantics allow (no rescorer
+    plugin, no LSH mask)."""
+    batcher = req.context.get("top_n_batcher")
+    if batcher is not None and rescorer is None and model.lsh is None:
+        return batcher.top_n(model, how_many, user_vector, exclude)
+    return model.top_n(how_many, user_vector=user_vector, exclude=exclude,
+                       rescorer=rescorer)
+
+
 # -- recommend ---------------------------------------------------------------
 
 def _recommend(req: Request):
@@ -132,8 +145,8 @@ def _recommend(req: Request):
     _check_exists(user_vector is not None, user_id)
     exclude = set() if consider_known else model.get_known_items(user_id)
     rescorer = _rescorer(model, "get_recommend_rescorer", req, user_id)
-    pairs = model.top_n(how_many + offset, user_vector=user_vector,
-                        exclude=exclude, rescorer=rescorer)
+    pairs = _dot_top_n(req, model, how_many + offset, user_vector,
+                       exclude, rescorer)
     return _slice(pairs, how_many, offset)
 
 
@@ -152,8 +165,8 @@ def _recommend_to_many(req: Request):
     _check_exists(bool(vectors), str(user_ids))
     mean_vector = np.mean(vectors, axis=0)
     rescorer = _rescorer(model, "get_recommend_rescorer", req, user_ids[0])
-    pairs = model.top_n(how_many + offset, user_vector=mean_vector,
-                        exclude=exclude, rescorer=rescorer)
+    pairs = _dot_top_n(req, model, how_many + offset, mean_vector,
+                       exclude, rescorer)
     return _slice(pairs, how_many, offset)
 
 
@@ -166,8 +179,7 @@ def _recommend_to_anonymous(req: Request):
     known = {i for i, _ in item_values}
     rescorer = _rescorer(model, "get_recommend_to_anonymous_rescorer", req,
                          sorted(known))
-    pairs = model.top_n(how_many + offset, user_vector=xu, exclude=known,
-                        rescorer=rescorer)
+    pairs = _dot_top_n(req, model, how_many + offset, xu, known, rescorer)
     return _slice(pairs, how_many, offset)
 
 
@@ -181,8 +193,7 @@ def _recommend_with_context(req: Request):
     xu = _build_temporary_user_vector(model, item_values, xu)
     exclude = model.get_known_items(user_id) | {i for i, _ in item_values}
     rescorer = _rescorer(model, "get_recommend_rescorer", req, user_id)
-    pairs = model.top_n(how_many + offset, user_vector=xu, exclude=exclude,
-                        rescorer=rescorer)
+    pairs = _dot_top_n(req, model, how_many + offset, xu, exclude, rescorer)
     return _slice(pairs, how_many, offset)
 
 

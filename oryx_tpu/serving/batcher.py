@@ -1,0 +1,134 @@
+"""Request micro-batcher: concurrent /recommend-family requests share
+one device dispatch.
+
+Reference equivalent: SURVEY §2.14 P6 — Tomcat's 400-thread pool fans a
+single request out across cores (ServingLayer.java:235); the TPU-native
+inversion batches many concurrent requests into ONE MXU matmul
+(`ALSServingModel.top_n_batch`).
+
+Design: adaptive queue-drain batching.  Handler threads enqueue a
+scoring job and block; a single dispatcher thread drains whatever is
+queued and issues one batched kernel call.  While that call is in
+flight, new jobs accumulate — the device's own latency IS the batching
+window, so an idle server adds no artificial delay (a lone request is
+dispatched immediately as a batch of one) and a saturated server
+coalesces aggressively.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterable
+
+import numpy as np
+
+__all__ = ["TopNBatcher"]
+
+
+class _Job:
+    __slots__ = ("model", "how_many", "vector", "exclude", "done",
+                 "result", "error")
+
+    def __init__(self, model, how_many: int, vector: np.ndarray,
+                 exclude: set[str]):
+        self.model = model
+        self.how_many = how_many
+        self.vector = vector
+        self.exclude = exclude
+        self.done = threading.Event()
+        self.result: list[tuple[str, float]] | None = None
+        self.error: BaseException | None = None
+
+
+class TopNBatcher:
+    """Coalesce concurrent dot-product top-N requests into batched
+    device calls.  Safe across model hot-swaps: jobs carry their model,
+    and each drain groups jobs by model identity."""
+
+    def __init__(self, max_batch: int = 1024, pipeline: int = 4):
+        """``pipeline`` dispatcher threads keep that many batched device
+        calls in flight at once: dispatch latency (dominated by the
+        host<->device round trip) overlaps instead of serializing, so
+        sustained throughput ~= mean_batch x pipeline / round_trip."""
+        self.max_batch = max_batch
+        self._cond = threading.Condition()
+        self._pending: list[_Job] = []
+        self._stopped = False
+        self._threads = [
+            threading.Thread(target=self._loop, daemon=True,
+                             name=f"TopNBatcher-{i}")
+            for i in range(max(1, pipeline))]
+        for t in self._threads:
+            t.start()
+        # drain-size histogram, exposed for tests and the metrics surface
+        self.batch_sizes: list[int] = []
+
+    def top_n(self, model, how_many: int, user_vector: np.ndarray,
+              exclude: Iterable[str] = ()) -> list[tuple[str, float]]:
+        """Blocking submit; returns the same pairs as ``model.top_n``
+        (exact scan, dot-product scores)."""
+        job = _Job(model, how_many,
+                   np.asarray(user_vector, dtype=np.float32), set(exclude))
+        with self._cond:
+            if self._stopped:
+                # shutdown race: keep-alive handler threads may outlive
+                # close(); degrade to an unbatched dispatch, not a 500
+                stopped = True
+            else:
+                stopped = False
+                self._pending.append(job)
+                self._cond.notify()
+        if stopped:
+            return model.top_n_batch([how_many], job.vector[None, :],
+                                     [job.exclude])[0]
+        job.done.wait()
+        if job.error is not None:
+            raise job.error
+        return job.result
+
+    def close(self) -> None:
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
+        for t in self._threads:
+            t.join(5.0)
+
+    # -- dispatcher ----------------------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._pending and not self._stopped:
+                    self._cond.wait()
+                if self._stopped:
+                    jobs, self._pending = self._pending, []
+                else:
+                    jobs = self._pending[:self.max_batch]
+                    del self._pending[:self.max_batch]
+                stopped = self._stopped
+            if jobs:
+                self._dispatch(jobs)
+            if stopped:
+                return
+
+    def _dispatch(self, jobs: list[_Job]) -> None:
+        by_model: dict[int, list[_Job]] = {}
+        for j in jobs:
+            by_model.setdefault(id(j.model), []).append(j)
+        for group in by_model.values():
+            model = group[0].model
+            try:
+                results = model.top_n_batch(
+                    [j.how_many for j in group],
+                    np.stack([j.vector for j in group]),
+                    [j.exclude for j in group])
+                for j, r in zip(group, results):
+                    j.result = r
+            except BaseException as e:  # noqa: BLE001 — surfaced per job
+                for j in group:
+                    j.error = e
+            self.batch_sizes.append(len(group))
+            if len(self.batch_sizes) > 10000:
+                del self.batch_sizes[:5000]
+            for j in group:
+                j.done.set()

@@ -1,0 +1,173 @@
+"""Request micro-batcher tests (SURVEY §2.14 P6: concurrent requests
+coalesce into one device dispatch; reference contrast:
+ServingLayer.java:235 thread-pool fan-out)."""
+
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from oryx_tpu.app.als.serving_model import ALSServingModel
+from oryx_tpu.bench.load import StaticModelManager
+from oryx_tpu.common.config import from_dict
+from oryx_tpu.lambda_rt.serving import ServingLayer
+from oryx_tpu.serving.batcher import TopNBatcher
+
+
+def _small_model(users=6, items=40, features=8, seed=5):
+    rng = np.random.default_rng(seed)
+    model = ALSServingModel(features=features, implicit=True)
+    for u in range(users):
+        model.set_user_vector(f"u{u}",
+                              rng.standard_normal(features).astype(np.float32))
+    for i in range(items):
+        model.set_item_vector(f"i{i}",
+                              rng.standard_normal(features).astype(np.float32))
+    return model
+
+
+def test_batcher_matches_single_request_path():
+    model = _small_model()
+    batcher = TopNBatcher()
+    try:
+        for u in range(6):
+            vec = model.get_user_vector(f"u{u}")
+            got = batcher.top_n(model, 5, vec, exclude={"i0", "i3"})
+            want = model.top_n(5, user_vector=vec, exclude={"i0", "i3"})
+            assert [i for i, _ in got] == [i for i, _ in want]
+            assert np.allclose([v for _, v in got], [v for _, v in want])
+    finally:
+        batcher.close()
+
+
+def test_batcher_concurrent_correctness_and_coalescing():
+    model = _small_model()
+
+    in_dispatch = threading.Event()
+    release = threading.Event()
+
+    class GatedModel:
+        """Delegate that stalls the first dispatch so later submissions
+        provably pile up into one drain."""
+
+        def __init__(self, inner):
+            self._inner = inner
+            self._first = True
+
+        def top_n_batch(self, how_many, vectors, exclude):
+            if self._first:
+                self._first = False
+                in_dispatch.set()
+                release.wait(5.0)
+            return self._inner.top_n_batch(how_many, vectors, exclude)
+
+    gated = GatedModel(model)
+    batcher = TopNBatcher(pipeline=1)  # single drain: coalescing is provable
+    results: dict[int, list] = {}
+
+    def submit(idx, uid, how_many):
+        results[idx] = batcher.top_n(gated, how_many,
+                                     model.get_user_vector(uid))
+
+    try:
+        first = threading.Thread(target=submit, args=(0, "u0", 3))
+        first.start()
+        assert in_dispatch.wait(5.0)
+        rest = [threading.Thread(target=submit, args=(i, f"u{i % 6}", 2 + i))
+                for i in range(1, 9)]
+        for t in rest:
+            t.start()
+        # the 8 jobs must all be pending before the gate opens
+        deadline = time.time() + 5.0
+        while len(batcher._pending) < 8 and time.time() < deadline:
+            time.sleep(0.005)
+        release.set()
+        first.join(5.0)
+        for t in rest:
+            t.join(5.0)
+    finally:
+        release.set()
+        batcher.close()
+
+    assert len(results) == 9
+    for i in range(1, 9):
+        uid, how_many = f"u{i % 6}", 2 + i
+        want = model.top_n(how_many,
+                           user_vector=model.get_user_vector(uid))
+        assert [x for x, _ in results[i]] == [x for x, _ in want]
+        assert np.allclose([v for _, v in results[i]],
+                           [v for _, v in want], rtol=1e-4)
+    # everything after the gate went through as one coalesced drain
+    assert max(batcher.batch_sizes) == 8
+
+
+def test_batcher_propagates_errors():
+    class Boom:
+        def top_n_batch(self, *a, **k):
+            raise ValueError("boom")
+
+    batcher = TopNBatcher()
+    try:
+        with pytest.raises(ValueError, match="boom"):
+            batcher.top_n(Boom(), 3, np.zeros(4, np.float32))
+    finally:
+        batcher.close()
+
+
+def test_top_n_batch_empty_batch():
+    model = _small_model()
+    assert model.top_n_batch(5, np.zeros((0, 8), np.float32)) == []
+
+
+def test_batcher_degrades_gracefully_after_close():
+    batcher = TopNBatcher()
+    batcher.close()
+    model = _small_model()
+    vec = model.get_user_vector("u0")
+    got = batcher.top_n(model, 3, vec)
+    want = model.top_n(3, user_vector=vec)
+    assert [i for i, _ in got] == [i for i, _ in want]
+
+
+class BatcherMockManager(StaticModelManager):
+    model = None
+
+
+def test_http_recommend_goes_through_batcher():
+    BatcherMockManager.model = _small_model(users=20, items=100)
+    cfg = from_dict({
+        "oryx.serving.model-manager-class":
+            "tests.test_batcher.BatcherMockManager",
+        "oryx.serving.application-resources": "oryx_tpu.serving.als",
+        "oryx.input-topic.broker": None,
+        "oryx.input-topic.message.topic": None,
+        "oryx.update-topic.broker": None,
+        "oryx.update-topic.message.topic": None,
+    })
+    layer = ServingLayer(cfg, port=0)
+    layer.start()
+    try:
+        base = f"http://127.0.0.1:{layer.port}"
+        errs = []
+
+        def hit(u):
+            try:
+                with urllib.request.urlopen(
+                        f"{base}/recommend/u{u}?howMany=4", timeout=10) as r:
+                    assert r.status == 200
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=hit, args=(u % 20,))
+                   for u in range(40)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(15.0)
+        assert not errs
+        # the shared batcher saw the traffic
+        assert sum(layer.top_n_batcher.batch_sizes) == 40
+    finally:
+        layer.close()

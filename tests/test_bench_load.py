@@ -4,32 +4,15 @@ opt-in profile; here a scaled-down smoke run is part of the suite)."""
 import numpy as np
 import pytest
 
-from oryx_tpu.bench.load import build_load_test_model, run_recommend_load
+from oryx_tpu.bench.load import (StaticModelManager, build_load_test_model,
+                                 run_recommend_load)
 from oryx_tpu.bench.traffic import ALS_ENDPOINTS, EndpointMix, run_traffic
 from oryx_tpu.common.config import from_dict
 from oryx_tpu.lambda_rt.serving import ServingLayer
 
 
-class LoadMockManager:
+class LoadMockManager(StaticModelManager):
     model = None
-
-    def __init__(self, config):
-        pass
-
-    def get_model(self):
-        return LoadMockManager.model
-
-    def get_config(self):
-        return None
-
-    def is_read_only(self):
-        return True
-
-    def consume(self, updates):
-        pass
-
-    def close(self):
-        pass
 
 
 @pytest.fixture(scope="module")
