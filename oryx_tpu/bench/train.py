@@ -1,0 +1,255 @@
+"""North-star training benchmark: MovieLens-20M-scale ALS on TPU.
+
+BASELINE.md names the north-star metric explicitly: "ALS epoch time +
+test RMSE on MovieLens-20M rank=100" (the reference defers batch-layer
+performance to Spark MLlib — docs/docs/performance.html "Batch Layer").
+There is no network egress in this environment, so the dataset is
+synthesized at MovieLens-20M shape (138,493 users x 26,744 items x 20M
+interactions, power-law popularity and user activity) WITH planted
+latent structure, so the held-out quality numbers are a real gate:
+
+ - implicit run: item selection is driven by per-user latent cluster
+   preferences; a correct rank-100 implicit ALS must push held-out
+   per-user AUC (Evaluation.java:70-136 semantics) far above 0.5.
+ - explicit run: ratings are true-factor dot products + N(0, sigma)
+   noise clipped to the 0.5..5 star scale; a correct solver drives
+   held-out RMSE (Evaluation.java:49-63 semantics) toward sigma.
+
+Epoch time = wall time of one full alternating sweep (both halves) on
+the device, measured after the compile-warm first sweep.
+
+Usage:  python -m oryx_tpu.bench.train [--ratings 20000000 --rank 100]
+Prints one JSON line; also writes the artifact file when --out is given.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import time
+
+import numpy as np
+
+from ..app.als.common import ParsedRatings
+from ..app.als.evaluation import area_under_curve, rmse
+from ..app.als.trainer import train_als
+
+__all__ = ["synthesize_movielens", "run_training_bench"]
+
+ML20M_USERS = 138_493
+ML20M_ITEMS = 26_744
+ML20M_RATINGS = 20_000_000
+
+
+def _sample_from_cdf(rng: np.random.Generator, cdf: np.ndarray,
+                     n: int) -> np.ndarray:
+    # float cumsum can leave cdf[-1] slightly below 1.0; clamp so a draw
+    # above it cannot index one past the end
+    idx = np.searchsorted(cdf, rng.random(n), side="right")
+    return np.minimum(idx, len(cdf) - 1).astype(np.int32)
+
+
+def synthesize_movielens(n_users: int = ML20M_USERS,
+                         n_items: int = ML20M_ITEMS,
+                         n_ratings: int = ML20M_RATINGS,
+                         n_clusters: int = 96,
+                         latent_rank: int = 12,
+                         noise_sigma: float = 0.5,
+                         seed: int = 7):
+    """MovieLens-shaped interactions with planted latent structure.
+
+    Returns (users, items, implicit_values, explicit_values, noise_sigma)
+    as deduplicated COO arrays in index space.  Item popularity and user
+    activity are power-law; each user belongs to a preference cluster and
+    85% of their interactions come from that cluster's item distribution
+    (that is the structure implicit ALS must recover).  Explicit values
+    are true-factor dots + gaussian noise on the 0.5..5 star scale.
+    """
+    rng = np.random.default_rng(seed)
+
+    # power-law global item popularity and user activity
+    item_pop = 1.0 / np.power(np.arange(1, n_items + 1), 0.8)
+    rng.shuffle(item_pop)
+    item_cdf = np.cumsum(item_pop / item_pop.sum())
+    user_act = np.exp(rng.normal(0.0, 1.0, n_users))
+    user_cdf = np.cumsum(user_act / user_act.sum())
+
+    users = _sample_from_cdf(rng, user_cdf, n_ratings)
+
+    # per-cluster item distributions: popularity reshaped by lognormal
+    # affinity noise -> clusters concentrate on different item subsets
+    user_cluster = rng.integers(0, n_clusters, n_users).astype(np.int32)
+    items = np.empty(n_ratings, dtype=np.int32)
+    from_cluster = rng.random(n_ratings) < 0.85
+    n_global = int(np.count_nonzero(~from_cluster))
+    items[~from_cluster] = _sample_from_cdf(rng, item_cdf, n_global)
+    rating_cluster = user_cluster[users]
+    for c in range(n_clusters):
+        mask = from_cluster & (rating_cluster == c)
+        m = int(np.count_nonzero(mask))
+        if m == 0:
+            continue
+        affinity = item_pop * np.exp(
+            np.random.default_rng(seed * 1000 + c).normal(0.0, 2.0, n_items))
+        cdf = np.cumsum(affinity / affinity.sum())
+        items[mask] = _sample_from_cdf(rng, cdf, m)
+
+    # dedupe (user,item) pairs; implicit strength = interaction count
+    key = users.astype(np.int64) * n_items + items
+    uniq, inverse = np.unique(key, return_inverse=True)
+    implicit_vals = np.bincount(inverse, minlength=len(uniq)).astype(
+        np.float32)
+    users = (uniq // n_items).astype(np.int32)
+    items = (uniq % n_items).astype(np.int32)
+
+    # explicit stars: true-factor dot + noise, 0.5..5 in half-star steps
+    scale = 1.0 / math.sqrt(latent_rank)
+    Zu = rng.normal(0.0, scale, (n_users, latent_rank)).astype(np.float32)
+    Zi = rng.normal(0.0, scale, (n_items, latent_rank)).astype(np.float32)
+    dots = np.einsum("nk,nk->n", Zu[users], Zi[items])
+    stars = 3.25 + 1.5 * dots + rng.normal(0.0, noise_sigma, len(users))
+    explicit_vals = np.clip(np.round(stars * 2.0) / 2.0, 0.5, 5.0).astype(
+        np.float32)
+
+    return users, items, implicit_vals, explicit_vals, noise_sigma
+
+
+def _split(rng: np.random.Generator, n: int, test_fraction: float):
+    test_mask = rng.random(n) < test_fraction
+    return ~test_mask, test_mask
+
+
+def _warm_test_mask(users, items, train_mask, test_mask):
+    """Mask of test pairs whose user AND item appear in training
+    (cold-start rows have zero factors and are not a solver-quality
+    signal; the reference's time-split evaluation has the same caveat)."""
+    seen_u = np.zeros(users.max() + 1, dtype=bool)
+    seen_i = np.zeros(items.max() + 1, dtype=bool)
+    seen_u[users[train_mask]] = True
+    seen_i[items[train_mask]] = True
+    return test_mask & seen_u[users] & seen_i[items]
+
+
+def run_training_bench(n_users: int = ML20M_USERS,
+                       n_items: int = ML20M_ITEMS,
+                       n_ratings: int = ML20M_RATINGS,
+                       rank: int = 100,
+                       iterations: int = 10,
+                       explicit_iterations: int = 5,
+                       lam: float = 0.1,
+                       alpha: float = 1.0,
+                       auc_max_users: int = 5_000,
+                       test_fraction: float = 0.05,
+                       seed: int = 7,
+                       run_explicit: bool = True) -> dict:
+    """Train implicit (AUC) and explicit (RMSE) ALS at MovieLens scale;
+    returns the metrics dict."""
+    t0 = time.perf_counter()
+    users, items, imp_vals, exp_vals, noise_sigma = synthesize_movielens(
+        n_users, n_items, n_ratings, seed=seed)
+    synth_s = time.perf_counter() - t0
+
+    rng = np.random.default_rng(seed + 1)
+    train_mask, test_mask = _split(rng, len(users), test_fraction)
+    user_ids = [str(u) for u in range(n_users)]
+    item_ids = [str(i) for i in range(n_items)]
+
+    def timed_train(values, implicit, iters):
+        ratings = ParsedRatings(user_ids, item_ids, users[train_mask],
+                                items[train_mask], values[train_mask])
+        marks = [time.perf_counter()]  # before packing + training
+        model = train_als(ratings, rank, lam, alpha, implicit, iters,
+                          seed=seed,
+                          on_iteration=lambda i, X, Y: marks.append(
+                              time.perf_counter()))
+        # sweeps[0] pays data packing/upload + XLA compilation;
+        # steady-state epoch time = mean of the later sweeps
+        sweeps = np.diff(marks)
+        return model, sweeps
+
+    # ---- implicit run (the Oryx default mode): held-out per-user AUC
+    t0 = time.perf_counter()
+    imp_model, sweeps = timed_train(imp_vals, True, iterations)
+    imp_total_s = time.perf_counter() - t0
+    imp_first_epoch_s = float(sweeps[0])
+    imp_epoch_s = float(np.mean(sweeps[1:])) if len(sweeps) > 1 else float(
+        sweeps[0])
+
+    warm = _warm_test_mask(users, items, train_mask, test_mask)
+    tu, ti = users[warm], items[warm]
+    if len(tu) and auc_max_users:
+        test_users = np.unique(tu)
+        if len(test_users) > auc_max_users:
+            chosen = rng.choice(test_users, auc_max_users, replace=False)
+            keep = np.isin(tu, chosen)
+            tu, ti = tu[keep], ti[keep]
+    t0 = time.perf_counter()
+    auc = area_under_curve(imp_model.X, imp_model.Y, tu, ti)
+    auc_eval_s = time.perf_counter() - t0
+
+    result = {
+        "dataset": f"synthetic-ml20m {n_users}x{n_items}, "
+                   f"{int(np.count_nonzero(train_mask))} train pairs",
+        "rank": rank,
+        "synth_s": round(synth_s, 1),
+        "implicit_iterations": iterations,
+        "implicit_epoch_s": round(imp_epoch_s, 3),
+        "implicit_first_epoch_s": round(imp_first_epoch_s, 3),
+        "implicit_total_s": round(imp_total_s, 1),
+        "implicit_test_auc": round(auc, 4),
+        "auc_test_pairs": int(len(tu)),
+        "auc_eval_s": round(auc_eval_s, 1),
+    }
+
+    # ---- explicit run: held-out RMSE vs the injected noise floor
+    if run_explicit:
+        t0 = time.perf_counter()
+        exp_model, esweeps = timed_train(exp_vals, False,
+                                         explicit_iterations)
+        exp_total_s = time.perf_counter() - t0
+        ok = warm
+        test_rmse = rmse(exp_model.X, exp_model.Y,
+                         users[ok], items[ok], exp_vals[ok])
+        result.update({
+            "explicit_iterations": explicit_iterations,
+            "explicit_epoch_s": round(float(np.mean(esweeps[1:]))
+                                      if len(esweeps) > 1
+                                      else float(esweeps[0]), 3),
+            "explicit_total_s": round(exp_total_s, 1),
+            "explicit_test_rmse": round(test_rmse, 4),
+            "explicit_noise_floor": noise_sigma,
+        })
+    return result
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--users", type=int, default=ML20M_USERS)
+    ap.add_argument("--items", type=int, default=ML20M_ITEMS)
+    ap.add_argument("--ratings", type=int, default=ML20M_RATINGS)
+    ap.add_argument("--rank", type=int, default=100)
+    ap.add_argument("--iterations", type=int, default=10)
+    ap.add_argument("--explicit-iterations", type=int, default=5)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--no-explicit", action="store_true")
+    ap.add_argument("--out", help="write full JSON artifact here")
+    args = ap.parse_args()
+
+    result = run_training_bench(
+        n_users=args.users, n_items=args.items, n_ratings=args.ratings,
+        rank=args.rank, iterations=args.iterations,
+        explicit_iterations=args.explicit_iterations, seed=args.seed,
+        run_explicit=not args.no_explicit)
+    import jax
+    result["device"] = str(jax.devices()[0].platform)
+    result["timestamp"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    line = json.dumps(result)
+    print(line)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -83,6 +83,78 @@ def test_blocked_layout_row_padding():
     assert int(blocks.i_mask.sum()) == len(ratings.users)
 
 
+def _rdf_schema(classification=True):
+    from oryx_tpu.app.schema import InputSchema
+    from oryx_tpu.common.config import from_dict
+    if classification:
+        cfg = from_dict({
+            "oryx.input-schema.feature-names": ["a", "b", "label"],
+            "oryx.input-schema.numeric-features": ["a", "b"],
+            "oryx.input-schema.target-feature": "label",
+        })
+    else:
+        cfg = from_dict({
+            "oryx.input-schema.feature-names": ["a", "b", "y"],
+            "oryx.input-schema.numeric-features": ["a", "b", "y"],
+            "oryx.input-schema.target-feature": "y",
+        })
+    return InputSchema(cfg)
+
+
+def test_distributed_forest_matches_single_device():
+    """Classification histograms are integer-valued, so the psum over
+    device shards is exact — the distributed forest must equal the
+    single-device forest split for split (reference capability:
+    distributed RandomForest at RDFUpdate.java:141-163)."""
+    from oryx_tpu.app.rdf.trainer import train_forest
+
+    rng = np.random.default_rng(3)
+    n = 500
+    x = rng.uniform(-1, 1, (n, 2)).astype(np.float32)
+    y = ((x[:, 0] + 0.3 * x[:, 1]) > 0.1).astype(np.int32)
+    schema = _rdf_schema(classification=True)
+    kwargs = dict(category_counts={}, num_trees=3, max_depth=4,
+                  max_split_candidates=16, impurity="gini", seed=99,
+                  num_classes=2)
+    single = train_forest(x, y, schema, **kwargs)
+    mesh = build_mesh(8)
+    dist = train_forest(x, y, schema, mesh=mesh, **kwargs)
+
+    np.testing.assert_allclose(dist.feature_importances,
+                               single.feature_importances)
+    from oryx_tpu.app.classreg import Example
+    probes = [Example(None, [float(rng.uniform(-1, 1)),
+                             float(rng.uniform(-1, 1)), None])
+              for _ in range(200)]
+    for tree_s, tree_d in zip(single.trees, dist.trees):
+        for ex in probes:
+            assert tree_s.find_terminal(ex).id == tree_d.find_terminal(ex).id
+
+
+def test_distributed_forest_regression_quality():
+    """Regression sums reassociate across shards (float drift can flip
+    near-tie splits), so the distributed check is a quality gate, not
+    bit equality."""
+    from oryx_tpu.app.rdf.trainer import train_forest
+
+    rng = np.random.default_rng(4)
+    n = 600
+    x = rng.uniform(0, 4, (n, 2)).astype(np.float32)
+    y = np.where(x[:, 0] < 2, 1.0, 5.0).astype(np.float32)
+    schema = _rdf_schema(classification=False)
+    mesh = build_mesh(8)
+    forest = train_forest(x, y, schema, category_counts={}, num_trees=3,
+                          max_depth=3, max_split_candidates=32,
+                          impurity="variance", seed=7, mesh=mesh)
+    from oryx_tpu.app.classreg import Example
+    preds = np.array([
+        np.mean([t.find_terminal(
+            Example(None, [float(a), float(b), None])).prediction.prediction
+            for t in forest.trees])
+        for a, b in x])
+    assert np.sqrt(np.mean((preds - y) ** 2)) < 0.5
+
+
 def test_train_step_is_jittable_and_finite():
     ratings = _synthetic(n_users=16, n_items=16, nnz=80)
     mesh = build_mesh(8)
