@@ -89,6 +89,11 @@ class ALSServingModel(FactorModelBase, ServingModel):
         super().__init__(features, implicit)
         self.rescorer_provider = rescorer_provider
         self._known_items: dict[str, set[str]] = {}
+        # incremental item -> #users-who-know-it counts, maintained on
+        # every known-items write so /mostPopularItems is O(items) per
+        # request instead of O(users × known-items) (the reference
+        # recounts per request: MostPopularItems.java:52)
+        self._item_pop: dict[str, int] = {}
         self._known_lock = AutoReadWriteLock()
         self.lsh = (LocalitySensitiveHash(sample_rate, features)
                     if sample_rate < 1.0 else None)
@@ -100,7 +105,11 @@ class ALSServingModel(FactorModelBase, ServingModel):
 
     def add_known_items(self, user_id: str, item_ids: Iterable[str]) -> None:
         with self._known_lock.write():
-            self._known_items.setdefault(user_id, set()).update(item_ids)
+            known = self._known_items.setdefault(user_id, set())
+            for iid in item_ids:
+                if iid not in known:
+                    known.add(iid)
+                    self._item_pop[iid] = self._item_pop.get(iid, 0) + 1
 
     def get_known_items(self, user_id: str) -> set[str]:
         with self._known_lock.read():
@@ -109,6 +118,12 @@ class ALSServingModel(FactorModelBase, ServingModel):
     def get_known_item_counts(self) -> dict[str, int]:
         with self._known_lock.read():
             return {u: len(s) for u, s in self._known_items.items() if s}
+
+    def get_item_popularity_counts(self) -> dict[str, int]:
+        """item -> number of users that know it, from the incremental
+        counter (not a rescan)."""
+        with self._known_lock.read():
+            return {i: c for i, c in self._item_pop.items() if c > 0}
 
     def retain_recent_and_known_items(self, user_ids: Sequence[str],
                                       item_ids: Sequence[str]) -> None:
@@ -122,9 +137,14 @@ class ALSServingModel(FactorModelBase, ServingModel):
         keep_items = set(item_ids) | self.Y.recent_ids()
         with self._known_lock.write():
             for u in [u for u in self._known_items if u not in keep_users]:
-                del self._known_items[u]
+                for iid in self._known_items.pop(u):
+                    self._item_pop[iid] -= 1
             for items in self._known_items.values():
+                for iid in items - keep_items:
+                    self._item_pop[iid] -= 1
                 items &= keep_items
+            self._item_pop = {i: c for i, c in self._item_pop.items()
+                              if c > 0}
 
     # -- scoring -------------------------------------------------------------
 

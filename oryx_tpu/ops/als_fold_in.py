@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["compute_target_qui", "fold_in_batch"]
+__all__ = ["compute_target_qui", "fold_in_batch", "fold_in_sequential"]
 
 
 def compute_target_qui(implicit: bool, value, current_value):
@@ -78,6 +78,65 @@ def fold_in_batch(solver, values, xu, yi, implicit: bool):
     new_xu, valid = _fold_in_kernel(solver.cholesky, values, xu, has_xu, yi,
                                     has_yi, implicit)
     return np.asarray(new_xu), np.asarray(valid)
+
+
+@partial(jax.jit, static_argnames=("implicit",))
+def _fold_in_seq_kernel(chol, values, yi, has_yi, xu0, has_xu0,
+                        implicit: bool):
+    def step(carry, ev):
+        xu, has_xu = carry
+        value, y, has_y = ev
+        qui = jnp.where(has_xu, jnp.dot(xu, y), 0.0)
+        current = jnp.where(has_xu, qui, 0.5)
+        target = compute_target_qui(implicit, value, current)
+        valid = has_y & ~jnp.isnan(target)
+        d_qui = jnp.where(valid, target - qui, 0.0)
+        d_xu = jax.scipy.linalg.cho_solve((chol, True), y * d_qui)
+        base = jnp.where(has_xu, xu, 0.0)
+        new_xu = jnp.where(valid, base + d_xu, xu)
+        return (new_xu, has_xu | valid), None
+
+    (xu, has_xu), _ = jax.lax.scan(step, (xu0, has_xu0),
+                                   (values, yi, has_yi))
+    return xu, has_xu
+
+
+def fold_in_sequential(solver, item_values, get_item_vector,
+                       xu: np.ndarray | None, implicit: bool,
+                       features: int):
+    """Sequentially fold an ordered list of (item_id, strength) context
+    events into a (possibly absent) user vector — the semantics of the
+    reference's per-item loop (EstimateForAnonymous.
+    buildTemporaryUserVector :74-96) — as ONE ``lax.scan`` device
+    dispatch instead of one dispatch per item.
+
+    ``get_item_vector(item_id) -> vector | None`` resolves item rows on
+    host; items without vectors are skipped (reference: null Yi).
+    Returns the new user vector, or None when nothing folded in and no
+    initial vector existed.
+    """
+    # pad the scan length to a power-of-two bucket so request-size
+    # variation doesn't retrace the kernel; padded rows carry
+    # has_yi=False and are no-ops
+    n = max(8, 1 << (len(item_values) - 1).bit_length()) \
+        if item_values else 8
+    values = np.zeros(n, dtype=np.float32)
+    yi = np.zeros((n, features), dtype=np.float32)
+    has_yi = np.zeros(n, dtype=bool)
+    for j, (item_id, value) in enumerate(item_values):
+        v = get_item_vector(item_id)
+        values[j] = value
+        if v is not None:
+            yi[j] = v
+            has_yi[j] = True
+    if not has_yi.any():
+        return xu
+    xu0 = np.zeros(features, dtype=np.float32) if xu is None \
+        else np.asarray(xu, dtype=np.float32)
+    new_xu, has_xu = jax.device_get(_fold_in_seq_kernel(
+        solver.cholesky, jnp.asarray(values), jnp.asarray(yi),
+        jnp.asarray(has_yi), jnp.asarray(xu0), xu is not None, implicit))
+    return np.asarray(new_xu) if has_xu else xu
 
 
 def compute_updated_xu(solver, value: float, xu, yi, implicit: bool):

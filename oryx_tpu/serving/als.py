@@ -99,19 +99,16 @@ def _build_temporary_user_vector(model: ALSServingModel,
                                  item_values: list[tuple[str, float]],
                                  xu: np.ndarray | None) -> np.ndarray | None:
     """Sequentially fold context items into a (possibly absent) user
-    vector (reference: EstimateForAnonymous.buildTemporaryUserVector)."""
+    vector (reference: EstimateForAnonymous.buildTemporaryUserVector).
+    The whole ordered context is one lax.scan device dispatch
+    (ops.als_fold_in.fold_in_sequential) instead of a per-item
+    round-trip."""
     solver = model.get_yty_solver(blocking=True)
     if solver is None:
         raise OryxServingException(503, "No solver available for model yet")
-    for item_id, value in item_values:
-        yi = model.get_item_vector(item_id)
-        if yi is None:
-            continue
-        new_xu = als_fold_in.compute_updated_xu(solver, value, xu, yi,
-                                                model.implicit)
-        if new_xu is not None:
-            xu = new_xu
-    return xu
+    return als_fold_in.fold_in_sequential(
+        solver, list(item_values), model.get_item_vector, xu,
+        model.implicit, model.features)
 
 
 def _rescorer(model: ALSServingModel, hook: str, req: Request, *args):
@@ -318,12 +315,8 @@ def _most_popular_items(req: Request):
     model = _als_model(req)
     how_many, offset = _how_many_offset(req)
     rescorer = _rescorer(model, "get_most_popular_items_rescorer", req)
-    item_counts: dict[str, int] = {}
-    for u, known in ((u, model.get_known_items(u))
-                     for u in model.all_user_ids()):
-        for iid in known:
-            item_counts[iid] = item_counts.get(iid, 0) + 1
-    ranked = sorted(item_counts.items(), key=lambda t: -t[1])
+    ranked = sorted(model.get_item_popularity_counts().items(),
+                    key=lambda t: -t[1])
     out = []
     for iid, c in ranked:
         if rescorer is not None and rescorer.is_filtered(iid):

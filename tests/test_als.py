@@ -282,6 +282,21 @@ def test_fraction_loaded_and_retain():
     assert model.get_known_items("u0") == {"i1"}
 
 
+def test_item_popularity_counts_incremental():
+    """The popularity counter tracks known-items writes AND model-swap
+    pruning exactly (backs O(items) /mostPopularItems)."""
+    model, X, Y = _make_serving_model(nu=4, ni=4)
+    model.add_known_items("u0", ["i1", "i2"])
+    model.add_known_items("u1", ["i1"])
+    model.add_known_items("u1", ["i1"])          # duplicate: no double count
+    assert model.get_item_popularity_counts() == {"i1": 2, "i2": 1}
+    model.X._recent.clear()
+    model.Y._recent.clear()
+    # u1 dropped entirely; u0 keeps only i1
+    model.retain_recent_and_known_items(["u0"], ["i1"])
+    assert model.get_item_popularity_counts() == {"i1": 1}
+
+
 def test_top_n_lowest_with_rescorer():
     from oryx_tpu.app.als.rescorer import Rescorer
 

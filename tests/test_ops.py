@@ -183,3 +183,44 @@ def test_fold_in_batch_matches_singles():
         else:
             assert valid[i]
             np.testing.assert_allclose(new_xu[i], single, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("implicit", [True, False])
+@pytest.mark.parametrize("start_with_xu", [True, False])
+def test_fold_in_sequential_matches_per_event_loop(implicit, start_with_xu):
+    """The one-dispatch lax.scan context fold-in must reproduce the
+    per-event compute_updated_xu loop exactly (including skipped items
+    and the running-vector dependency between events)."""
+    s, _, rng = _setup_solver(k=6, seed=23)
+    item_vecs = {f"i{j}": rng.standard_normal(6).astype(np.float32) * 0.5
+                 for j in range(8)}
+    item_values = [("i0", 1.0), ("missing", 2.0), ("i1", -0.5),
+                   ("i2", 3.0), ("i3", 0.0), ("i4", 1.5)]
+    xu0 = (rng.standard_normal(6).astype(np.float32) * 0.1
+           if start_with_xu else None)
+
+    expected = xu0
+    for iid, value in item_values:
+        yi = item_vecs.get(iid)
+        if yi is None:
+            continue
+        new = als_fold_in.compute_updated_xu(s, value, expected, yi, implicit)
+        if new is not None:
+            expected = new
+
+    got = als_fold_in.fold_in_sequential(
+        s, item_values, item_vecs.get, xu0, implicit, 6)
+    if expected is None:
+        assert got is None
+    else:
+        np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_fold_in_sequential_all_missing_returns_initial():
+    s, _, rng = _setup_solver(k=6, seed=24)
+    assert als_fold_in.fold_in_sequential(
+        s, [("nope", 1.0)], lambda _: None, None, True, 6) is None
+    xu = rng.standard_normal(6).astype(np.float32)
+    got = als_fold_in.fold_in_sequential(
+        s, [("nope", 1.0)], lambda _: None, xu, True, 6)
+    np.testing.assert_allclose(got, xu)
