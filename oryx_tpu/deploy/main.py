@@ -71,8 +71,12 @@ def _topic_config(config: Config) -> list[tuple[str, str]]:
 def _cmd_kafka_setup(args) -> int:
     from ..kafka import utils as kafka_utils
     config = _load_config(args.conf)
-    for broker, topic in _topic_config(config):
-        kafka_utils.maybe_create_topic(broker, topic)
+    # reference oryx-run.sh:343,356 — input topic 4 partitions (P7
+    # parallel ingest), update topic 1 (total order for MODEL/UP replay)
+    partitions = [config.get_int("oryx.input-topic.partitions")
+                  if config.has_path("oryx.input-topic.partitions") else 4, 1]
+    for (broker, topic), n in zip(_topic_config(config), partitions):
+        kafka_utils.maybe_create_topic(broker, topic, partitions=n)
         print(f"{topic} @ {broker}: "
               f"{'exists' if kafka_utils.topic_exists(broker, topic) else 'missing'}")
     return 0
@@ -86,15 +90,16 @@ def _cmd_kafka_tail(args) -> int:
     print("Tailing input and update topics; Ctrl-C to stop", file=sys.stderr)
     try:
         import time
-        offsets = {topic: 0 for topic, _, _ in consumers}
+        offsets = {topic: [0] * broker.num_partitions(topic)
+                   for topic, broker, _ in consumers}
         while True:
             idle = True
             for topic, broker, _ in consumers:
-                end = broker.latest_offset(topic)
-                for km in broker.read_range(topic, offsets[topic], end):
+                ends = broker.latest_offsets(topic)
+                for km in broker.read_ranges(topic, offsets[topic], ends):
                     print(f"{topic}\t{km.key}\t{km.message}")
                     idle = False
-                offsets[topic] = end
+                offsets[topic] = ends
             if args.once and idle:
                 return 0
             if idle:

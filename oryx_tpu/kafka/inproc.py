@@ -1,5 +1,5 @@
-"""In-process message broker with Kafka topic/offset/consumer-group
-semantics.
+"""In-process message broker with Kafka topic/partition/offset/
+consumer-group semantics.
 
 Plays two roles, mirroring how the reference treats Kafka:
 
@@ -9,19 +9,26 @@ Plays two roles, mirroring how the reference treats Kafka:
    LocalZKServer.java:41).  Here the broker IS in-process, so tests and
    single-host deployments need no external services at all.
 
-2. The durable input/update log — topics are append-only logs with
-   monotonically increasing offsets; consumers resume from committed
-   per-group offsets (reference: consumer-offset storage in ZooKeeper,
-   KafkaUtils.java:134-180) or replay from the beginning
+2. The durable input/update log — topics are one or more append-only
+   partition logs with monotonically increasing per-partition offsets;
+   records with the same key always land in the same partition (keyed
+   crc32 partitioning, Kafka's contract), keyless records round-robin.
+   Consumers resume from committed per-(group, topic, partition)
+   offsets (reference: per-partition consumer-offset storage in
+   ZooKeeper, KafkaUtils.java:134-180) or replay from the beginning
    (auto.offset.reset=smallest, how serving/speed layers rebuild model
    state — ModelManagerListener.java:126, SpeedLayer.java:113).
+   Ordering is guaranteed within a partition only — exactly Kafka's
+   guarantee (P7 message-partition parallelism, SURVEY §2.14).
 
 Brokers are addressed by URI: ``memory://<name>`` resolves to a shared
 named broker in this process.  Optionally ``persist_dir``-backed: each
-topic an append-only JSONL file (line-buffered), offsets in a sidecar
-JSON written behind with a short throttle — single-host restart
-durability; a crash can lose only the last unflushed offset commits,
-which at-least-once delivery turns into redelivery, not loss.
+partition an append-only JSONL file (one write syscall per record),
+topic partition counts in a ``<topic>.meta.json`` sidecar, offsets in
+an ``offsets.json`` sidecar written behind with a short throttle —
+single-host restart durability; a crash can lose only the last
+unflushed offset commits, which at-least-once delivery turns into
+redelivery, not loss.
 """
 
 from __future__ import annotations
@@ -30,6 +37,8 @@ import json
 import os
 import threading
 import time
+import zlib
+from concurrent.futures import ThreadPoolExecutor
 from typing import Iterator
 
 from ..common.io_utils import mkdirs
@@ -82,24 +91,33 @@ def resolve_broker(broker_uri: str) -> "InProcBroker":
         # the way the reference's layers share a real Kafka cluster
         path = os.path.abspath(broker_uri[len("file://"):])
         return get_broker(name=f"file:{path}", persist_dir=path)
+    from .client import kafka_client_available
+    if kafka_client_available():
+        from .client import get_kafka_broker
+        return get_kafka_broker(broker_uri)
     raise RuntimeError(
         f"Kafka-protocol broker {broker_uri!r} requested but no Kafka client "
         "library is available in this environment; use a memory:// or "
         "file:// broker, or install kafka-python")
 
 
-class _Topic:
-    """One topic log.  When persisted, the on-disk JSONL file is the
+class _Partition:
+    """One partition log.  When persisted, the on-disk JSONL file is the
     source of truth shared BETWEEN processes: appends go through a raw
     O_APPEND fd (one write syscall per record — atomic on a local fs,
     so concurrent writers such as batch and speed never interleave a
     record), and readers tail the file for records other processes
-    appended (``_refresh_locked``)."""
+    appended (``_refresh_locked``).
 
-    def __init__(self, name: str, persist_path: str | None):
-        self.name = name
+    Each partition has its OWN lock, so multi-partition drains really do
+    read/refresh concurrently; ``notify`` (the owning topic's wake-up)
+    is called after every visible append so blocking consumers learn of
+    new data on any partition."""
+
+    def __init__(self, notify, persist_path: str | None):
         self.log: list[tuple[str | None, str]] = []
-        self.cond = threading.Condition()
+        self._lock = threading.RLock()
+        self._notify = notify
         self.persist_path = persist_path
         self._fd: int | None = None
         self._read_pos = 0
@@ -107,20 +125,21 @@ class _Topic:
         if persist_path:
             self._fd = os.open(persist_path,
                                os.O_APPEND | os.O_CREAT | os.O_WRONLY, 0o644)
-            with self.cond:
+            with self._lock:
                 self._refresh_locked()
 
-    def _refresh_locked(self) -> None:
+    def _refresh_locked(self) -> bool:
         """Pull records appended by other processes into the in-memory
-        view.  Caller holds ``cond``."""
+        view.  Caller holds ``_lock``; returns True when new records
+        appeared (caller decides whether to notify)."""
         if self.persist_path is None:
-            return
+            return False
         try:
             size = os.path.getsize(self.persist_path)
         except OSError:
-            return
+            return False
         if size <= self._read_pos:
-            return
+            return False
         with open(self.persist_path, "rb") as f:
             f.seek(self._read_pos)
             chunk = self._tail + f.read()
@@ -133,32 +152,49 @@ class _Topic:
                 k, m = json.loads(raw.decode("utf-8"))
                 self.log.append((k, m))
                 appended = True
-        if appended:
-            self.cond.notify_all()
+        return appended
 
     def append(self, key: str | None, message: str) -> int:
         record = (json.dumps([key, message]) + "\n").encode("utf-8")
-        with self.cond:
+        with self._lock:
             if self._fd is not None:
                 # the file is the source of truth: write, then re-read
                 # up to and past our record so in-memory offsets always
                 # reflect file order even with concurrent writers
                 os.write(self._fd, record)
                 self._refresh_locked()
-                return len(self.log) - 1
-            self.log.append((key, message))
-            offset = len(self.log) - 1
-            self.cond.notify_all()
-            return offset
+                offset = len(self.log) - 1
+            else:
+                self.log.append((key, message))
+                offset = len(self.log) - 1
+        self._notify()
+        return offset
 
     def refresh(self) -> None:
-        with self.cond:
-            self._refresh_locked()
+        with self._lock:
+            appended = self._refresh_locked()
+        if appended:
+            self._notify()
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self.log)
+
+    def get(self, pos: int) -> tuple[str | None, str]:
+        with self._lock:
+            return self.log[pos]
 
     def latest_offset(self) -> int:
-        with self.cond:
+        with self._lock:
             self._refresh_locked()
             return len(self.log)
+
+    def read_range(self, start: int, end: int) -> list[KeyMessage]:
+        if end <= start:
+            return []
+        with self._lock:
+            self._refresh_locked()
+            return [KeyMessage(k, m) for k, m in self.log[start:end]]
 
     def close(self) -> None:
         if self._fd is not None:
@@ -166,14 +202,68 @@ class _Topic:
             self._fd = None
 
 
+class _Topic:
+    """A named set of partition logs with Kafka's keyed-partitioning
+    contract: same key -> same partition, keyless -> round-robin."""
+
+    def __init__(self, name: str, paths: list[str | None]):
+        self.name = name
+        self.cond = threading.Condition()
+        self.partitions = [_Partition(self._notify, p) for p in paths]
+        self._rr = 0
+        self._rr_lock = threading.Lock()
+
+    def _notify(self) -> None:
+        with self.cond:
+            self.cond.notify_all()
+
+    @property
+    def num_partitions(self) -> int:
+        return len(self.partitions)
+
+    def partition_for(self, key: str | None) -> int:
+        n = len(self.partitions)
+        if n == 1:
+            return 0
+        if key is None:
+            with self._rr_lock:
+                self._rr = (self._rr + 1) % n
+                return self._rr
+        return zlib.crc32(key.encode("utf-8")) % n
+
+    def refresh_all(self) -> None:
+        for p in self.partitions:
+            p.refresh()
+
+    def close(self) -> None:
+        for p in self.partitions:
+            p.close()
+
+
+def _partition_paths(persist_dir: str | None, topic: str,
+                     n: int) -> list[str | None]:
+    """Partition 0 always lives in the flat ``<topic>.topic.jsonl`` file
+    (the pre-partitioning layout); partitions 1.. get ``.p<i>`` files.
+    A process that lazily sees the topic as 1-partition therefore writes
+    to what everyone else reads as partition 0 — layout disagreement
+    degrades key-affinity, never loses records."""
+    if persist_dir is None:
+        return [None] * n
+    return [os.path.join(persist_dir, f"{topic}.topic.jsonl")] + [
+        os.path.join(persist_dir, f"{topic}.p{i}.topic.jsonl")
+        for i in range(1, n)]
+
+
 class InProcBroker:
-    """Named in-process broker: topics + per-group committed offsets."""
+    """Named in-process broker: partitioned topics + per-group
+    committed per-partition offsets."""
 
     def __init__(self, name: str = "default", persist_dir: str | None = None):
         self.name = name
         self._persist_dir = mkdirs(persist_dir) if persist_dir else None
         self._topics: dict[str, _Topic] = {}
-        self._offsets: dict[tuple[str, str], int] = {}  # (group, topic) -> next offset
+        # (group, topic, partition) -> next offset
+        self._offsets: dict[tuple[str, str, int], int] = {}
         self._lock = threading.Lock()
         self._offsets_path = (os.path.join(self._persist_dir, "offsets.json")
                               if self._persist_dir else None)
@@ -181,13 +271,30 @@ class InProcBroker:
         self._offsets_last_write = 0.0
         if self._offsets_path and os.path.exists(self._offsets_path):
             with open(self._offsets_path, encoding="utf-8") as f:
-                self._offsets = {tuple(k.split("\x00", 1)): v  # type: ignore[misc]
-                                 for k, v in json.load(f).items()}
+                self._offsets = _decode_offsets(json.load(f))
         if self._persist_dir:
+            metas: dict[str, int] = {}
+            legacy: set[str] = set()
             for fn in os.listdir(self._persist_dir):
-                if fn.endswith(".topic.jsonl"):
-                    t = fn[:-len(".topic.jsonl")]
-                    self._topics[t] = _Topic(t, os.path.join(self._persist_dir, fn))
+                if fn.endswith(".meta.json"):
+                    t = fn[:-len(".meta.json")]
+                    with open(os.path.join(self._persist_dir, fn),
+                              encoding="utf-8") as f:
+                        metas[t] = int(json.load(f).get("partitions", 1))
+                elif fn.endswith(".topic.jsonl"):
+                    base = fn[:-len(".topic.jsonl")]
+                    # partition files look like "<topic>.p<i>"; flat files
+                    # are single-partition logs
+                    head, dot, tail = base.rpartition(".")
+                    if not (dot and tail.startswith("p")
+                            and tail[1:].isdigit()):
+                        legacy.add(base)
+            for t, n in metas.items():
+                self._topics[t] = _Topic(
+                    t, _partition_paths(self._persist_dir, t, n))
+            for t in legacy - set(metas):
+                self._topics[t] = _Topic(
+                    t, _partition_paths(self._persist_dir, t, 1))
 
     # -- topic admin (KafkaUtils parity: …/kafka/util/KafkaUtils.java) ------
 
@@ -196,19 +303,39 @@ class InProcBroker:
             return topic in self._topics
 
     def create_topic(self, topic: str, partitions: int = 1) -> None:
+        if partitions < 1:
+            raise ValueError(f"partitions must be >= 1, got {partitions}")
         with self._lock:
-            if topic not in self._topics:
-                path = (os.path.join(self._persist_dir, f"{topic}.topic.jsonl")
-                        if self._persist_dir else None)
-                self._topics[topic] = _Topic(topic, path)
+            existing = self._topics.get(topic)
+            if existing is not None:
+                if existing.num_partitions != partitions:
+                    raise ValueError(
+                        f"topic {topic!r} exists with "
+                        f"{existing.num_partitions} partition(s), "
+                        f"requested {partitions}")
+                return
+            self._topics[topic] = _Topic(
+                topic, _partition_paths(self._persist_dir, topic, partitions))
+            if self._persist_dir and partitions > 1:
+                meta = os.path.join(self._persist_dir, f"{topic}.meta.json")
+                tmp = meta + ".tmp"
+                with open(tmp, "w", encoding="utf-8") as f:
+                    json.dump({"partitions": partitions}, f)
+                os.replace(tmp, meta)
 
     def delete_topic(self, topic: str) -> None:
         with self._lock:
             t = self._topics.pop(topic, None)
             if t:
                 t.close()
-                if t.persist_path and os.path.exists(t.persist_path):
-                    os.remove(t.persist_path)
+                for p in t.partitions:
+                    if p.persist_path and os.path.exists(p.persist_path):
+                        os.remove(p.persist_path)
+                if self._persist_dir:
+                    meta = os.path.join(self._persist_dir,
+                                        f"{topic}.meta.json")
+                    if os.path.exists(meta):
+                        os.remove(meta)
             self._offsets = {k: v for k, v in self._offsets.items()
                              if k[1] != topic}
             self._write_offsets_locked(drop_topic=topic)
@@ -216,67 +343,130 @@ class InProcBroker:
     def _topic(self, topic: str) -> _Topic:
         with self._lock:
             if topic not in self._topics:
-                path = (os.path.join(self._persist_dir, f"{topic}.topic.jsonl")
-                        if self._persist_dir else None)
-                self._topics[topic] = _Topic(topic, path)
+                # consult the on-disk meta before defaulting to one
+                # partition: another process (e.g. the kafka-setup CLI)
+                # may have created the topic since this broker started
+                n = 1
+                if self._persist_dir:
+                    meta = os.path.join(self._persist_dir,
+                                        f"{topic}.meta.json")
+                    if os.path.exists(meta):
+                        with open(meta, encoding="utf-8") as f:
+                            n = int(json.load(f).get("partitions", 1))
+                self._topics[topic] = _Topic(
+                    topic, _partition_paths(self._persist_dir, topic, n))
             return self._topics[topic]
+
+    def num_partitions(self, topic: str) -> int:
+        return self._topic(topic).num_partitions
 
     # -- produce / consume --------------------------------------------------
 
     def send(self, topic: str, key: str | None, message: str) -> int:
-        return self._topic(topic).append(key, message)
+        """Append to the key's partition; returns the record's offset
+        within that partition."""
+        t = self._topic(topic)
+        return t.partitions[t.partition_for(key)].append(key, message)
 
     def latest_offset(self, topic: str) -> int:
-        return self._topic(topic).latest_offset()
+        """Single-partition convenience; multi-partition topics must use
+        :meth:`latest_offsets`."""
+        t = self._topic(topic)
+        if t.num_partitions != 1:
+            raise ValueError(
+                f"topic {topic!r} has {t.num_partitions} partitions; "
+                "use latest_offsets")
+        return t.partitions[0].latest_offset()
+
+    def latest_offsets(self, topic: str) -> list[int]:
+        """Per-partition end offsets (reference: KafkaUtils.
+        getTopicOffsets fanning over partitions, KafkaUtils.java:134)."""
+        return [p.latest_offset() for p in self._topic(topic).partitions]
 
     def read_range(self, topic: str, start: int, end: int) -> list[KeyMessage]:
-        """Snapshot of the [start, end) offset slice — the public read
-        path for micro-batch drains (batch/speed layers)."""
-        if end <= start:
-            return []
+        """Snapshot of the [start, end) offset slice of a
+        single-partition topic — the simple micro-batch drain."""
         t = self._topic(topic)
-        with t.cond:
-            t._refresh_locked()
-            return [KeyMessage(k, m) for k, m in t.log[start:end]]
+        if t.num_partitions != 1:
+            raise ValueError(
+                f"topic {topic!r} has {t.num_partitions} partitions; "
+                "use read_ranges")
+        return t.partitions[0].read_range(start, end)
+
+    def read_ranges(self, topic: str, starts: list[int | None],
+                    ends: list[int]) -> list[KeyMessage]:
+        """Drain [start, end) from every partition, partitions read
+        concurrently (P7 parallel ingest), results concatenated in
+        partition order — per-partition record order is preserved,
+        cross-partition order is unspecified (Kafka's guarantee)."""
+        t = self._topic(topic)
+        n = t.num_partitions
+        if len(starts) != n or len(ends) != n:
+            raise ValueError(
+                f"expected {n} starts/ends for topic {topic!r}")
+        jobs = [(p, 0 if s is None else s, e)
+                for p, (s, e) in zip(t.partitions, zip(starts, ends))]
+        if n == 1:
+            return jobs[0][0].read_range(jobs[0][1], jobs[0][2])
+        with ThreadPoolExecutor(max_workers=n) as pool:
+            chunks = list(pool.map(
+                lambda j: j[0].read_range(j[1], j[2]), jobs))
+        return [km for chunk in chunks for km in chunk]
 
     def consume(self, topic: str, group: str | None = None,
                 from_beginning: bool = False,
                 poll_timeout_sec: float = 0.1,
                 stop: threading.Event | None = None,
                 max_idle_sec: float | None = None) -> Iterator[KeyMessage]:
-        """Blocking iterator over a topic.
+        """Blocking iterator over every partition of a topic.
 
-        With a ``group``, starts at the group's committed offset (or per
-        ``from_beginning`` when none) and commits as it yields — the
-        at-least-once resume contract of the reference's manually
-        managed offsets (UpdateOffsetsFn.java:37-64).  Without a group,
-        starts at the latest (or 0 with ``from_beginning``) and never
-        commits.  Ends when ``stop`` is set or ``max_idle_sec`` elapses
-        with no new messages.
+        With a ``group``, each partition starts at the group's committed
+        offset for that partition (or per ``from_beginning`` when none)
+        and commits as it yields — the at-least-once resume contract of
+        the reference's manually managed per-partition offsets
+        (UpdateOffsetsFn.java:37-64).  Without a group, starts at the
+        latest (or 0 with ``from_beginning``) and never commits.
+        Partitions are interleaved round-robin; order within a
+        partition is preserved.  Ends when ``stop`` is set or
+        ``max_idle_sec`` elapses with no new messages.
         """
         t = self._topic(topic)
-        if group is not None:
-            pos = self.get_offset(group, topic)
-            if pos is None:
-                pos = 0 if from_beginning else t.latest_offset()
-        else:
-            pos = 0 if from_beginning else t.latest_offset()
+        n = t.num_partitions
+        pos: list[int] = []
+        for part in range(n):
+            p = None
+            if group is not None:
+                p = self.get_offset(group, topic, part)
+            if p is None:
+                p = 0 if from_beginning \
+                    else t.partitions[part].latest_offset()
+            pos.append(p)
         idle_since = time.monotonic()
+        next_part = 0
         try:
             while True:
-                with t.cond:
-                    while pos >= len(t.log):
-                        if stop is not None and stop.is_set():
-                            return
-                        if (max_idle_sec is not None
-                                and time.monotonic() - idle_since > max_idle_sec):
-                            return
+                while True:
+                    ready = [i for i in range(n)
+                             if pos[i] < t.partitions[i].size()]
+                    if ready:
+                        break
+                    if stop is not None and stop.is_set():
+                        return
+                    if (max_idle_sec is not None
+                            and time.monotonic() - idle_since > max_idle_sec):
+                        return
+                    with t.cond:
+                        # bounded wait: an append between the size check
+                        # and this wait costs at most one poll interval
                         t.cond.wait(poll_timeout_sec)
-                        # appends from other processes sharing the
-                        # persisted log never signal our Condition
-                        t._refresh_locked()
-                    key, message = t.log[pos]
-                pos += 1
+                    # appends from other processes sharing the
+                    # persisted logs never signal our Condition
+                    t.refresh_all()
+                # round-robin across ready partitions for fairness
+                part = min(ready, key=lambda i: (i - next_part) % n)
+                key, message = t.partitions[part].get(pos[part])
+                pos[part] += 1
+                next_part = (part + 1) % n
                 idle_since = time.monotonic()
                 # Commit only after the consumer's processing (the code
                 # between yields) completes and it comes back for more:
@@ -287,43 +477,59 @@ class InProcBroker:
                 # redelivers it — duplicates are possible, loss is not.
                 yield KeyMessage(key, message)
                 if group is not None:
-                    self.set_offset(group, topic, pos)
+                    self.set_offset(group, topic, pos[part], part)
                 if stop is not None and stop.is_set():
                     return
         finally:
             if group is not None:
                 self.flush()
 
-    # -- offsets (ZK offset-store parity) -----------------------------------
+    # -- offsets (ZK per-partition offset-store parity) ----------------------
 
-    def get_offset(self, group: str, topic: str) -> int | None:
+    def get_offset(self, group: str, topic: str,
+                   partition: int = 0) -> int | None:
         with self._lock:
-            return self._offsets.get((group, topic))
+            return self._offsets.get((group, topic, partition))
 
-    def set_offset(self, group: str, topic: str, offset: int) -> None:
+    def get_offsets(self, group: str, topic: str) -> list[int | None]:
+        n = self.num_partitions(topic)
         with self._lock:
-            self._offsets[(group, topic)] = offset
-            # time-throttled write-behind: losing the last interval's
-            # commits on crash only causes redelivery, which the
-            # at-least-once contract already allows.  Consumers flush()
-            # on exit (consume's finally) to bound the window.
-            if self._offsets_path:
-                self._offsets_dirty_since = self._offsets_dirty_since or time.monotonic()
-                if (time.monotonic() - self._offsets_last_write
-                        >= _OFFSET_FLUSH_SEC):
-                    self._write_offsets_locked()
+            return [self._offsets.get((group, topic, p)) for p in range(n)]
+
+    def set_offset(self, group: str, topic: str, offset: int,
+                   partition: int = 0) -> None:
+        with self._lock:
+            self._offsets[(group, topic, partition)] = offset
+            self._maybe_write_offsets_locked()
+
+    def set_offsets(self, group: str, topic: str,
+                    offsets: list[int]) -> None:
+        with self._lock:
+            for p, off in enumerate(offsets):
+                self._offsets[(group, topic, p)] = off
+            self._maybe_write_offsets_locked()
+
+    def _maybe_write_offsets_locked(self) -> None:
+        # time-throttled write-behind: losing the last interval's
+        # commits on crash only causes redelivery, which the
+        # at-least-once contract already allows.  Consumers flush()
+        # on exit (consume's finally) to bound the window.
+        if self._offsets_path:
+            self._offsets_dirty_since = self._offsets_dirty_since or time.monotonic()
+            if (time.monotonic() - self._offsets_last_write
+                    >= _OFFSET_FLUSH_SEC):
+                self._write_offsets_locked()
 
     def _write_offsets_locked(self, drop_topic: str | None = None) -> None:
         if self._offsets_path:
             # merge with on-disk entries so processes sharing the broker
             # dir don't clobber each other's consumer-group commits —
             # each process only advances the groups it consumes as
-            merged: dict[tuple[str, str], int] = {}
+            merged: dict[tuple[str, str, int], int] = {}
             if os.path.exists(self._offsets_path):
                 try:
                     with open(self._offsets_path, encoding="utf-8") as f:
-                        merged = {tuple(k.split("\x00", 1)): v  # type: ignore[misc]
-                                  for k, v in json.load(f).items()}
+                        merged = _decode_offsets(json.load(f))
                 except (OSError, ValueError):
                     pass
             merged.update(self._offsets)
@@ -332,7 +538,8 @@ class InProcBroker:
                           if k[1] != drop_topic}
             tmp = self._offsets_path + ".tmp"
             with open(tmp, "w", encoding="utf-8") as f:
-                json.dump({"\x00".join(k): v for k, v in merged.items()}, f)
+                json.dump({f"{g}\x00{t}\x00{p}": v
+                           for (g, t, p), v in merged.items()}, f)
             os.replace(tmp, self._offsets_path)
             self._offsets_dirty_since = None
             self._offsets_last_write = time.monotonic()
@@ -352,11 +559,27 @@ class InProcBroker:
                 topic.close()
 
     def fill_in_latest_offsets(self, group: str, topics: list[str]) -> None:
-        """For any topic without a committed offset, commit the latest —
-        'start from now' semantics (reference: KafkaUtils.fillInLatestOffsets)."""
+        """For any (topic, partition) without a committed offset, commit
+        the latest — 'start from now' semantics (reference:
+        KafkaUtils.fillInLatestOffsets)."""
         for topic in topics:
-            if self.get_offset(group, topic) is None:
-                self.set_offset(group, topic, self.latest_offset(topic))
+            latest = self.latest_offsets(topic)
+            for part, end in enumerate(latest):
+                if self.get_offset(group, topic, part) is None:
+                    self.set_offset(group, topic, end, part)
+
+
+def _decode_offsets(raw: dict[str, int]) -> dict[tuple[str, str, int], int]:
+    """Offsets sidecar decoding; legacy 2-token keys (pre-partitioning
+    brokers) map to partition 0."""
+    out: dict[tuple[str, str, int], int] = {}
+    for k, v in raw.items():
+        parts = k.split("\x00")
+        if len(parts) == 3:
+            out[(parts[0], parts[1], int(parts[2]))] = v
+        elif len(parts) == 2:
+            out[(parts[0], parts[1], 0)] = v
+    return out
 
 
 class InProcTopicProducer(TopicProducer):

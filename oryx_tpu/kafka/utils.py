@@ -23,7 +23,13 @@ __all__ = [
 def maybe_create_topic(broker_uri: str, topic: str, partitions: int = 1) -> None:
     broker = resolve_broker(broker_uri)
     if broker.topic_exists(topic):
-        _log.info("No need to create topic %s as it already exists", topic)
+        existing = broker.num_partitions(topic)
+        if existing != partitions:
+            _log.warning(
+                "Topic %s already exists with %d partition(s), not the "
+                "requested %d; leaving it as-is", topic, existing, partitions)
+        else:
+            _log.info("No need to create topic %s as it already exists", topic)
     else:
         _log.info("Creating topic %s with %d partition(s)", topic, partitions)
         broker.create_topic(topic, partitions)
@@ -42,15 +48,19 @@ def delete_topic(broker_uri: str, topic: str) -> None:
         _log.info("No need to delete topic %s as it does not exist", topic)
 
 
-def get_offsets(broker_uri: str, group: str, topics: list[str]) -> dict[str, int | None]:
+def get_offsets(broker_uri: str, group: str,
+                topics: list[str]) -> dict[str, list[int | None]]:
+    """Per-(topic, partition) committed offsets, as topic -> offsets
+    vector (reference: KafkaUtils.getOffsets fanning over partitions)."""
     broker = resolve_broker(broker_uri)
-    return {t: broker.get_offset(group, t) for t in topics}
+    return {t: broker.get_offsets(group, t) for t in topics}
 
 
-def set_offsets(broker_uri: str, group: str, offsets: dict[str, int]) -> None:
+def set_offsets(broker_uri: str, group: str,
+                offsets: dict[str, list[int]]) -> None:
     broker = resolve_broker(broker_uri)
-    for topic, off in offsets.items():
-        broker.set_offset(group, topic, off)
+    for topic, offs in offsets.items():
+        broker.set_offsets(group, topic, offs)
 
 
 def fill_in_latest_offsets(broker_uri: str, group: str, topics: list[str]) -> None:

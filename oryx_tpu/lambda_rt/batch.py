@@ -84,13 +84,15 @@ class BatchLayer:
         at-least-once with idempotent overwrite (reference semantics)."""
         timestamp_ms = int(time.time() * 1000)
         broker = resolve_broker(self.input_broker)
-        start_offset = broker.get_offset(self._group, self.input_topic)
-        if start_offset is None:
-            start_offset = 0  # first run reads from the beginning
-        end_offset = broker.latest_offset(self.input_topic)
+        # per-partition offsets (P7 — reference: UpdateOffsetsFn.java:
+        # 37-64 commits per (topic, partition)); first run reads each
+        # partition from the beginning, partitions drain concurrently
+        starts = [s if s is not None else 0
+                  for s in broker.get_offsets(self._group, self.input_topic)]
+        ends = broker.latest_offsets(self.input_topic)
 
-        new_data: list[KeyMessage] = broker.read_range(
-            self.input_topic, start_offset, end_offset)
+        new_data: list[KeyMessage] = broker.read_ranges(
+            self.input_topic, starts, ends)
 
         past_data = data_store.read_all_data(self.data_dir)
 
@@ -108,7 +110,7 @@ class BatchLayer:
                                         self.model_dir, producer)
         data_store.save_generation(self.data_dir, timestamp_ms, new_data)
         # offsets commit only after the update completed (at-least-once)
-        broker.set_offset(self._group, self.input_topic, end_offset)
+        broker.set_offsets(self._group, self.input_topic, ends)
         broker.flush()
 
         data_store.delete_old_data(self.data_dir, self.max_age_data_hours)

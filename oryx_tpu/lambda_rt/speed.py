@@ -74,15 +74,16 @@ class SpeedLayer:
 
     def _micro_batch_loop(self) -> None:
         broker = resolve_broker(self.input_broker)
-        pos = broker.get_offset(self._group, self.input_topic)
-        if pos is None:
-            pos = broker.latest_offset(self.input_topic)
+        latest = broker.latest_offsets(self.input_topic)
+        pos = [p if p is not None else latest[i]
+               for i, p in enumerate(
+                   broker.get_offsets(self._group, self.input_topic))]
         while not self._stop.is_set():
             self._stop.wait(self.generation_interval_sec)
-            end = broker.latest_offset(self.input_topic)
-            if end <= pos:
+            ends = broker.latest_offsets(self.input_topic)
+            if all(e <= p for e, p in zip(ends, pos)):
                 continue
-            new_data = broker.read_range(self.input_topic, pos, end)
+            new_data = broker.read_ranges(self.input_topic, pos, ends)
             try:
                 updates = self.model_manager.build_updates(new_data)
                 for update in updates:
@@ -90,17 +91,18 @@ class SpeedLayer:
             except Exception:  # noqa: BLE001 — micro-batch failure is
                 _log.exception("Micro-batch failed")  # survivable
                 continue
-            pos = end
-            broker.set_offset(self._group, self.input_topic, pos)
+            pos = ends
+            broker.set_offsets(self._group, self.input_topic, pos)
 
     def run_one_micro_batch(self) -> None:
         """Synchronously process pending input once (test/ops hook)."""
         broker = resolve_broker(self.input_broker)
-        pos = broker.get_offset(self._group, self.input_topic) or 0
-        end = broker.latest_offset(self.input_topic)
-        if end <= pos:
+        pos = [p or 0
+               for p in broker.get_offsets(self._group, self.input_topic)]
+        ends = broker.latest_offsets(self.input_topic)
+        if all(e <= p for e, p in zip(ends, pos)):
             return
-        new_data = broker.read_range(self.input_topic, pos, end)
+        new_data = broker.read_ranges(self.input_topic, pos, ends)
         for update in self.model_manager.build_updates(new_data):
             self._producer.send(KEY_UP, update)
-        broker.set_offset(self._group, self.input_topic, end)
+        broker.set_offsets(self._group, self.input_topic, ends)

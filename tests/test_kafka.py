@@ -153,8 +153,124 @@ def test_utils_module():
     utils.maybe_create_topic(uri, "t1")  # idempotent
     get_broker("utils-test").send("t1", None, "x")
     utils.fill_in_latest_offsets(uri, "g", ["t1"])
-    assert utils.get_offsets(uri, "g", ["t1"]) == {"t1": 1}
-    utils.set_offsets(uri, "g", {"t1": 0})
-    assert utils.get_offsets(uri, "g", ["t1"]) == {"t1": 0}
+    assert utils.get_offsets(uri, "g", ["t1"]) == {"t1": [1]}
+    utils.set_offsets(uri, "g", {"t1": [0]})
+    assert utils.get_offsets(uri, "g", ["t1"]) == {"t1": [0]}
     utils.delete_topic(uri, "t1")
     assert not utils.topic_exists(uri, "t1")
+
+
+# -- multi-partition topics (P7 message-partition parallelism) ---------------
+
+def test_keyed_partitioning_is_stable(broker):
+    """Same key -> same partition, across sends (Kafka's contract)."""
+    broker.create_topic("p", partitions=4)
+    assert broker.num_partitions("p") == 4
+    t = broker._topic("p")
+    for key in ("alpha", "beta", "gamma", "delta", "epsilon"):
+        parts = {t.partition_for(key) for _ in range(10)}
+        assert len(parts) == 1
+    # keyless records round-robin over all partitions
+    assert {t.partition_for(None) for _ in range(16)} == {0, 1, 2, 3}
+
+
+def test_partition_order_preserved_and_concurrent_drain(broker):
+    """4-partition ingest: per-partition record order survives the
+    concurrent read_ranges drain (the batch layer's P7 path)."""
+    broker.create_topic("p", partitions=4)
+    per_key = {f"k{i}": [f"k{i}-m{j}" for j in range(25)] for i in range(8)}
+    # interleave writers across keys
+    for j in range(25):
+        for key in per_key:
+            broker.send("p", key, per_key[key][j])
+    ends = broker.latest_offsets("p")
+    assert sum(ends) == 200
+    got = broker.read_ranges("p", [0, 0, 0, 0], ends)
+    assert len(got) == 200
+    seen: dict[str, list[str]] = {}
+    for km in got:
+        seen.setdefault(km.key, []).append(km.message)
+    assert seen == per_key  # order within each key's partition intact
+
+
+def test_per_partition_offsets_resume(broker):
+    """Committed per-(group, topic, partition) offsets resume exactly
+    (reference: per-partition ZK offsets, KafkaUtils.java:134-180)."""
+    broker.create_topic("p", partitions=4)
+    for i in range(40):
+        broker.send("p", f"k{i % 8}", f"m{i}")
+    ends = broker.latest_offsets("p")
+    # consume everything once with a group
+    stop = threading.Event()
+    first = [km.message for km in broker.consume(
+        "p", group="g", from_beginning=True, max_idle_sec=0.2, stop=stop)]
+    assert sorted(first) == sorted(f"m{i}" for i in range(40))
+    assert broker.get_offsets("g", "p") == ends
+    # new records land after the committed offsets; resume sees only them
+    broker.send("p", "k0", "late0")
+    broker.send("p", "k5", "late1")
+    second = [km.message for km in broker.consume(
+        "p", group="g", from_beginning=True, max_idle_sec=0.2)]
+    assert sorted(second) == ["late0", "late1"]
+
+
+def test_partitioned_persistence_round_trip(tmp_path):
+    """Partition logs + meta survive a broker restart; per-partition
+    offsets reload."""
+    d = str(tmp_path / "broker")
+    b1 = InProcBroker("p-persist-1-" + str(time.monotonic_ns()), persist_dir=d)
+    b1.create_topic("p", partitions=3)
+    for i in range(12):
+        b1.send("p", f"k{i % 5}", f"m{i}")
+    ends = b1.latest_offsets("p")
+    b1.set_offsets("g", "p", ends)
+    b1.close()
+
+    b2 = InProcBroker("p-persist-2-" + str(time.monotonic_ns()), persist_dir=d)
+    assert b2.num_partitions("p") == 3
+    assert b2.latest_offsets("p") == ends
+    assert b2.get_offsets("g", "p") == ends
+    got = b2.read_ranges("p", [0, 0, 0], ends)
+    assert sorted(km.message for km in got) == sorted(f"m{i}" for i in range(12))
+    b2.close()
+
+
+def test_create_topic_partition_mismatch_rejected(broker):
+    broker.create_topic("p", partitions=2)
+    broker.create_topic("p", partitions=2)  # idempotent
+    with pytest.raises(ValueError, match="partition"):
+        broker.create_topic("p", partitions=3)
+
+
+def test_scalar_api_rejects_multipartition(broker):
+    broker.create_topic("p", partitions=2)
+    with pytest.raises(ValueError, match="partitions"):
+        broker.latest_offset("p")
+    with pytest.raises(ValueError, match="partitions"):
+        broker.read_range("p", 0, 1)
+
+
+def test_stale_single_partition_writer_lands_in_p0(tmp_path):
+    """A process that lazily sees a topic as 1 partition writes to the
+    flat file — which IS partition 0 of the real layout — so layout
+    disagreement between processes degrades key affinity but never
+    strands records.  A late-starting broker consults the on-disk meta
+    and sees the full layout."""
+    d = str(tmp_path / "broker")
+    setup = InProcBroker("meta-setup-" + str(time.monotonic_ns()),
+                         persist_dir=d)
+    setup.create_topic("In", partitions=4)
+    setup.close()
+
+    # a second broker over the same dir that never called create_topic
+    # resolves the partition count from the meta sidecar
+    late = InProcBroker("meta-late-" + str(time.monotonic_ns()),
+                        persist_dir=d)
+    assert late.num_partitions("In") == 4
+    for i in range(8):
+        late.send("In", f"k{i}", f"m{i}")
+    ends = late.latest_offsets("In")
+    assert sum(ends) == 8
+    got = late.read_ranges("In", [0] * 4, ends)
+    assert sorted(km.message for km in got) == [f"m{i}" for i in range(8)]
+    late.close()

@@ -157,8 +157,9 @@ def test_speed_layer_micro_batch_loop(tmp_path):
         while time.time() < deadline:
             after = broker.latest_offset("ItUpdate")
             if after > before:
-                topic = broker._topic("ItUpdate")
-                ups = [m for k, m in topic.log[before:] if k == "UP"]
+                ups = [km.message
+                       for km in broker.read_range("ItUpdate", before, after)
+                       if km.key == "UP"]
                 if ups:
                     break
             time.sleep(0.05)

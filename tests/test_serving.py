@@ -211,8 +211,8 @@ def test_pref_post_and_delete_write_input(server):
     assert _status_of(server, "/pref/U0/I7", method="POST",
                       data=b"3.5") in (200, 204)
     assert _status_of(server, "/pref/U0/I7", method="DELETE") in (200, 204)
-    topic = broker._topic("TestInput")
-    new = [m for _, m in topic.log[start:]]
+    end = broker.latest_offset("TestInput")
+    new = [km.message for km in broker.read_range("TestInput", start, end)]
     assert new == ["U0,I7,3.5", "U0,I7,"]
 
 
@@ -226,8 +226,9 @@ def test_ingest_plain_and_gzip(server):
     st2 = _status_of(server, "/ingest", method="POST", data=gz,
                      headers={"Content-Type": "application/gzip"})
     assert st2 == 200
-    topic = broker._topic("TestInput")
-    assert [m for _, m in topic.log[start:]] == \
+    end = broker.latest_offset("TestInput")
+    assert [km.message
+            for km in broker.read_range("TestInput", start, end)] == \
         ["U1,I2,1", "U1,I3,2.0", "U4,I5,1"]
 
 
