@@ -73,8 +73,7 @@ def _cmd_kafka_setup(args) -> int:
     config = _load_config(args.conf)
     # reference oryx-run.sh:343,356 — input topic 4 partitions (P7
     # parallel ingest), update topic 1 (total order for MODEL/UP replay)
-    partitions = [config.get_int("oryx.input-topic.partitions")
-                  if config.has_path("oryx.input-topic.partitions") else 4, 1]
+    partitions = [kafka_utils.input_topic_partitions(config), 1]
     for (broker, topic), n in zip(_topic_config(config), partitions):
         kafka_utils.maybe_create_topic(broker, topic, partitions=n)
         print(f"{topic} @ {broker}: "

@@ -16,6 +16,7 @@ from ..api.serving import (AbstractServingModelManager, OryxServingException,
 from ..common.config import Config
 from ..kafka.api import KEY_MODEL, KEY_UP
 from ..lambda_rt.http import Request, Route
+from ..serving import console
 from ..serving.framework import get_serving_model, send_input
 
 __all__ = ["ExampleServingModel", "ExampleServingModelManager", "ROUTES"]
@@ -83,4 +84,10 @@ ROUTES = [
     Route("GET", "/distinct", _distinct),
     Route("GET", "/distinct/{word}", _distinct_word),
     Route("POST", "/add/{line}", _add, mutates=True),
+    console.console_route("Word Count Example", [
+        console.Endpoint("/distinct"),
+        console.Endpoint("/distinct/{0}", ("word",)),
+        console.Endpoint("/add/{0}", ("line",), method="POST"),
+        console.Endpoint("/ready"),
+    ]),
 ]

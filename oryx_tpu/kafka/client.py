@@ -66,6 +66,11 @@ class KafkaBroker:
         self.bootstrap = bootstrap
         self._lock = threading.Lock()
         self._producer = None
+        # cached clients: one metadata/drain consumer (group=None) plus
+        # one per consumer group for offset commits — a new KafkaConsumer
+        # per call would pay a TCP bootstrap + metadata fetch each time
+        self._cached: dict[str | None, object] = {}
+        self._cached_lock = threading.Lock()
 
     # -- clients -------------------------------------------------------------
 
@@ -74,9 +79,31 @@ class KafkaBroker:
         return KafkaAdminClient(bootstrap_servers=self.bootstrap)
 
     def _consumer(self, group: str | None = None, **kw):
+        """A fresh consumer the CALLER owns and closes (needed for
+        subscribe-based streaming consumption)."""
         from kafka import KafkaConsumer
         return KafkaConsumer(bootstrap_servers=self.bootstrap,
                              group_id=group, enable_auto_commit=False, **kw)
+
+    class _shared_consumer:
+        """Context manager lending the cached consumer for ``group``
+        under the cache lock (assignment state is mutable, so borrowers
+        must be serialized)."""
+
+        def __init__(self, broker: "KafkaBroker", group: str | None):
+            self._broker = broker
+            self._group = group
+
+        def __enter__(self):
+            self._broker._cached_lock.acquire()
+            c = self._broker._cached.get(self._group)
+            if c is None:
+                c = self._broker._consumer(group=self._group)
+                self._broker._cached[self._group] = c
+            return c
+
+        def __exit__(self, *exc):
+            self._broker._cached_lock.release()
 
     def _get_producer(self):
         from kafka import KafkaProducer
@@ -119,12 +146,9 @@ class KafkaBroker:
             admin.close()
 
     def num_partitions(self, topic: str) -> int:
-        c = self._consumer()
-        try:
+        with self._shared_consumer(self, None) as c:
             parts = c.partitions_for_topic(topic)
             return len(parts) if parts else 1
-        finally:
-            c.close()
 
     # -- produce / consume ---------------------------------------------------
 
@@ -144,14 +168,11 @@ class KafkaBroker:
 
     def latest_offsets(self, topic: str) -> list[int]:
         from kafka import TopicPartition
-        c = self._consumer()
-        try:
+        with self._shared_consumer(self, None) as c:
             parts = sorted(c.partitions_for_topic(topic) or [0])
             tps = [TopicPartition(topic, p) for p in parts]
             end = c.end_offsets(tps)
             return [end[tp] for tp in tps]
-        finally:
-            c.close()
 
     def read_range(self, topic: str, start: int, end: int) -> list[KeyMessage]:
         return self.read_ranges(topic, [start], [end])
@@ -159,8 +180,7 @@ class KafkaBroker:
     def read_ranges(self, topic: str, starts: list[int | None],
                     ends: list[int]) -> list[KeyMessage]:
         from kafka import TopicPartition
-        c = self._consumer()
-        try:
+        with self._shared_consumer(self, None) as c:
             parts = sorted(c.partitions_for_topic(topic) or [0])
             out: list[KeyMessage] = []
             for p, (s, e) in zip(parts, zip(starts, ends)):
@@ -170,26 +190,27 @@ class KafkaBroker:
                 tp = TopicPartition(topic, p)
                 c.assign([tp])
                 c.seek(tp, s)
-                pos = s
                 deadline = time.monotonic() + 30
-                while pos < e:
+                # completion is judged by the consumer POSITION, not a
+                # record count: compacted/transactional topics have
+                # offset gaps, so counting records would never terminate
+                while c.position(tp) < e:
                     if time.monotonic() >= deadline:
                         # a silent partial drain would let the caller
                         # commit past unread records (permanent loss);
                         # failing loudly keeps at-least-once intact —
                         # the layer retries the whole range next run
                         raise TimeoutError(
-                            f"drained only [{s}, {pos}) of [{s}, {e}) "
-                            f"from {topic}/p{p} within 30s")
+                            f"drained only [{s}, {c.position(tp)}) of "
+                            f"[{s}, {e}) from {topic}/p{p} within 30s")
                     for recs in c.poll(timeout_ms=500).values():
                         for r in recs:
                             if r.offset >= e:
                                 break
                             out.append(KeyMessage(_dec(r.key), _dec(r.value)))
-                            pos = r.offset + 1
+            # leave the shared consumer unassigned for the next borrower
+            c.unsubscribe()
             return out
-        finally:
-            c.close()
 
     def consume(self, topic: str, group: str | None = None,
                 from_beginning: bool = False,
@@ -234,20 +255,14 @@ class KafkaBroker:
     def get_offset(self, group: str, topic: str,
                    partition: int = 0) -> int | None:
         from kafka import TopicPartition
-        c = self._consumer(group=group)
-        try:
+        with self._shared_consumer(self, group) as c:
             return c.committed(TopicPartition(topic, partition))
-        finally:
-            c.close()
 
     def get_offsets(self, group: str, topic: str) -> list[int | None]:
         from kafka import TopicPartition
-        c = self._consumer(group=group)
-        try:
+        with self._shared_consumer(self, group) as c:
             parts = sorted(c.partitions_for_topic(topic) or [0])
             return [c.committed(TopicPartition(topic, p)) for p in parts]
-        finally:
-            c.close()
 
     def set_offset(self, group: str, topic: str, offset: int,
                    partition: int = 0) -> None:
@@ -261,14 +276,12 @@ class KafkaBroker:
                         by_partition: dict[int, int]) -> None:
         from kafka import TopicPartition
         from kafka.structs import OffsetAndMetadata
-        c = self._consumer(group=group)
-        try:
+        with self._shared_consumer(self, group) as c:
             tps = {TopicPartition(topic, p): OffsetAndMetadata(off, None)
                    for p, off in by_partition.items()}
             c.assign(list(tps))
             c.commit(tps)
-        finally:
-            c.close()
+            c.unsubscribe()
 
     def fill_in_latest_offsets(self, group: str, topics: list[str]) -> None:
         for topic in topics:
@@ -289,6 +302,10 @@ class KafkaBroker:
             if self._producer is not None:
                 self._producer.close()
                 self._producer = None
+        with self._cached_lock:
+            for c in self._cached.values():
+                c.close()
+            self._cached.clear()
 
 
 class KafkaTopicProducer(TopicProducer):

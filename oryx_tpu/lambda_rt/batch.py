@@ -17,6 +17,7 @@ import time
 
 from ..common.config import Config
 from ..common.lang import load_instance
+from ..kafka import utils as kafka_utils
 from ..kafka.api import KeyMessage
 from ..kafka.inproc import InProcTopicProducer, resolve_broker
 from . import data_store
@@ -55,6 +56,11 @@ class BatchLayer:
     def start(self) -> None:
         _log.info("Starting batch layer (generation interval %ds)",
                   self.generation_interval_sec)
+        # create the input topic at its configured partition count before
+        # any lazy access can freeze it at one partition
+        kafka_utils.maybe_create_topic(
+            self.input_broker, self.input_topic,
+            partitions=kafka_utils.input_topic_partitions(self.config))
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="BatchLayer")
         self._thread.start()

@@ -26,7 +26,15 @@ from typing import Any, Callable, NamedTuple
 
 from ..api.serving import HasCSV, OryxServingException
 
-__all__ = ["Route", "Request", "HttpApp", "json_or_csv"]
+__all__ = ["Route", "Request", "HttpApp", "json_or_csv", "HtmlResponse"]
+
+
+class HtmlResponse:
+    """A handler result rendered verbatim as text/html (console pages —
+    reference: AbstractConsoleResource returning MediaType.TEXT_HTML)."""
+
+    def __init__(self, html: str):
+        self.html = html
 
 
 class Route(NamedTuple):
@@ -74,6 +82,8 @@ def _compile(pattern: str) -> re.Pattern:
 def json_or_csv(value: Any, accept: str) -> tuple[bytes, str]:
     """Render a response honoring Accept: JSON by default, CSV lines when
     text/csv is asked for (reference: CSVMessageBodyWriter)."""
+    if isinstance(value, HtmlResponse):
+        return value.html.encode(), "text/html; charset=utf-8"
     wants_csv = "text/csv" in accept or (
         "text/plain" in accept and "json" not in accept)
     if wants_csv:
@@ -230,6 +240,12 @@ class HttpApp:
         payload, ctype = json_or_csv(result, accept)
         handler.send_response(status)
         handler.send_header("Content-Type", ctype)
+        if isinstance(result, HtmlResponse):
+            # console pages carry anti-clickjacking + cache headers
+            # (reference: AbstractConsoleResource.getHTML sets
+            # X-Frame-Options SAMEORIGIN and Cache-Control public)
+            handler.send_header("X-Frame-Options", "SAMEORIGIN")
+            handler.send_header("Cache-Control", "public")
         if gzip_ok and len(payload) > 256:
             payload = gzip.compress(payload)
             handler.send_header("Content-Encoding", "gzip")
@@ -251,9 +267,30 @@ class HttpApp:
             pass
 
 
-def make_server(app: HttpApp, port: int) -> ThreadingHTTPServer:
+def make_server(app: HttpApp, port: int,
+                ssl_context=None) -> ThreadingHTTPServer:
+    """HTTP (or, with ``ssl_context``, HTTPS) server hosting the app.
+
+    The reference's connector is HTTP or HTTPS+HTTP/2 depending on
+    keystore config (ServingLayer.java:202-255); here TLS termination is
+    stdlib ``ssl`` wrapping the listening socket and the dialect spoken
+    is HTTP/1.1 with keep-alive — the capability parity that matters is
+    the secured connector itself.  The handshake is deferred to the
+    per-connection handler thread (``do_handshake_on_connect=False``),
+    so a client that connects and never speaks stalls one worker
+    thread, not the accept loop."""
     class _Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
+
+        def setup(self):
+            if ssl_context is not None:
+                # handshake here, in this connection's worker thread,
+                # with a bound so a silent client can't hold the thread
+                # forever; the accept loop was never involved
+                self.request.settimeout(30)
+                self.request.do_handshake()
+                self.request.settimeout(None)
+            super().setup()
 
         def log_message(self, fmt, *args):  # quiet
             pass
@@ -277,4 +314,9 @@ def make_server(app: HttpApp, port: int) -> ThreadingHTTPServer:
         # default backlog of 5 refuses connections under load
         request_queue_size = 512
 
-    return _Server(("0.0.0.0", port), _Handler)
+    server = _Server(("0.0.0.0", port), _Handler)
+    if ssl_context is not None:
+        server.socket = ssl_context.wrap_socket(
+            server.socket, server_side=True,
+            do_handshake_on_connect=False)
+    return server

@@ -33,7 +33,22 @@ class ServingLayer:
     def __init__(self, config: Config, port: int | None = None):
         self.config = config
         api = "oryx.serving.api"
-        self.port = port if port is not None else config.get_int(f"{api}.port")
+        # TLS: when a keystore (PEM certificate + key) is configured the
+        # layer serves HTTPS on secure-port (reference connector spec:
+        # ServingLayer.java:202-255; keys reference.conf:221-237).  The
+        # JKS keystore becomes a PEM cert/key chain — the Python-native
+        # equivalent — with keystore-password decrypting the key;
+        # key-alias does not apply to PEM and is accepted but unused.
+        self.keystore_file = config.get_optional_string(f"{api}.keystore-file")
+        self.keystore_password = config.get_optional_string(
+            f"{api}.keystore-password")
+        self.key_alias = config.get_optional_string(f"{api}.key-alias")
+        if port is not None:
+            self.port = port
+        elif self.keystore_file:
+            self.port = config.get_int(f"{api}.secure-port")
+        else:
+            self.port = config.get_int(f"{api}.port")
         self.read_only = config.get_bool(f"{api}.read-only")
         self.user_name = config.get_optional_string(f"{api}.user-name")
         self.password = config.get_optional_string(f"{api}.password")
@@ -57,7 +72,9 @@ class ServingLayer:
         self.input_producer = None
         if not self.read_only and self.input_broker and self.input_topic:
             if not self.no_init_topics:
-                kafka_utils.maybe_create_topic(self.input_broker, self.input_topic)
+                kafka_utils.maybe_create_topic(
+                    self.input_broker, self.input_topic,
+                    partitions=kafka_utils.input_topic_partitions(config))
             self.input_producer = InProcTopicProducer(self.input_broker,
                                                       self.input_topic)
 
@@ -108,8 +125,16 @@ class ServingLayer:
                 target=logging_call(self._consume_updates, "serving-consume"),
                 daemon=True, name="ServingLayerConsume")
             self._consume_thread.start()
-        self._server = make_server(self.app, self.port)
+        ssl_context = None
+        if self.keystore_file:
+            import ssl
+            ssl_context = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ssl_context.load_cert_chain(self.keystore_file,
+                                        password=self.keystore_password)
+        self._server = make_server(self.app, self.port,
+                                   ssl_context=ssl_context)
         self.port = self._server.server_address[1]
+        self.scheme = "https" if ssl_context is not None else "http"
         self._server_thread = threading.Thread(
             target=self._server.serve_forever, daemon=True,
             name="ServingLayerHTTP")

@@ -14,6 +14,7 @@ import threading
 
 from ..common.config import Config
 from ..common.lang import load_instance, logging_call
+from ..kafka import utils as kafka_utils
 from ..kafka.api import KEY_UP, KeyMessage
 from ..kafka.inproc import InProcTopicProducer, resolve_broker
 
@@ -44,6 +45,11 @@ class SpeedLayer:
     def start(self) -> None:
         _log.info("Starting speed layer (micro-batch %ds)",
                   self.generation_interval_sec)
+        # create the input topic at its configured partition count before
+        # any lazy access can freeze it at one partition
+        kafka_utils.maybe_create_topic(
+            self.input_broker, self.input_topic,
+            partitions=kafka_utils.input_topic_partitions(self.config))
         # model state = full update-topic replay from offset 0
         # (reference: auto.offset.reset=smallest, SpeedLayer.java:113)
         self._consume_thread = threading.Thread(

@@ -28,6 +28,7 @@ from ..app.als.serving_model import ALSServingModel
 from ..common import text as text_utils
 from ..lambda_rt.http import Request, Route
 from ..ops import als_fold_in
+from . import console
 from .framework import get_serving_model, send_input
 
 __all__ = ["ROUTES", "IDValue", "IDCount"]
@@ -426,4 +427,17 @@ ROUTES = [
     Route("POST", "/pref/{userID}/{itemID}", _pref_post, mutates=True),
     Route("DELETE", "/pref/{userID}/{itemID}", _pref_delete, mutates=True),
     Route("POST", "/ingest", _ingest, mutates=True),
+    console.console_route("Alternating Least Squares", [
+        console.Endpoint("/recommend/{0}", ("userID",)),
+        console.Endpoint("/recommendToAnonymous/{0}", ("itemID(=strength)",)),
+        console.Endpoint("/similarity/{0}/{1}", ("itemID1", "itemID2")),
+        console.Endpoint("/estimate/{0}/{1}", ("userID", "itemID")),
+        console.Endpoint("/because/{0}/{1}", ("userID", "itemID")),
+        console.Endpoint("/knownItems/{0}", ("userID",)),
+        console.Endpoint("/mostActiveUsers"),
+        console.Endpoint("/mostPopularItems"),
+        console.Endpoint("/allUserIDs"),
+        console.Endpoint("/allItemIDs"),
+        console.Endpoint("/ready"),
+    ]),
 ]

@@ -14,6 +14,7 @@ from ..app.rdf.serving import RDFServingModel
 from ..common import text as text_utils
 from ..lambda_rt.http import Request, Route
 from .als import IDValue
+from . import console
 from .framework import get_serving_model, send_input
 
 __all__ = ["ROUTES"]
@@ -114,4 +115,11 @@ ROUTES = [
     Route("GET", "/feature/importance", _feature_importance_all),
     Route("GET", "/feature/importance/{featureNumber}",
           _feature_importance_one),
+    console.console_route("Random Decision Forest", [
+        console.Endpoint("/predict/{0}", ("datum (CSV)",)),
+        console.Endpoint("/classificationDistribution/{0}", ("datum (CSV)",)),
+        console.Endpoint("/feature/importance"),
+        console.Endpoint("/train/{0}", ("datum (CSV)",), method="POST"),
+        console.Endpoint("/ready"),
+    ]),
 ]

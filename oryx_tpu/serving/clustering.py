@@ -10,6 +10,7 @@ from __future__ import annotations
 from ..api.serving import OryxServingException
 from ..common import text as text_utils
 from ..lambda_rt.http import Request, Route
+from . import console
 from .framework import get_serving_model, send_input
 
 __all__ = ["ROUTES"]
@@ -81,4 +82,10 @@ ROUTES = [
     Route("GET", "/add/{datum}", _add),
     Route("POST", "/add", _add_post),
     Route("GET", "/distanceToNearest/{datum}", _distance_to_nearest),
+    console.console_route("k-means Clustering", [
+        console.Endpoint("/assign/{0}", ("datum (CSV)",)),
+        console.Endpoint("/distanceToNearest/{0}", ("datum (CSV)",)),
+        console.Endpoint("/add/{0}", ("datum (CSV)",)),
+        console.Endpoint("/ready"),
+    ]),
 ]

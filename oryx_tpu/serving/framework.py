@@ -7,6 +7,7 @@ AbstractOryxResource.java:52-... (model gating, input send).
 
 from __future__ import annotations
 
+import zlib
 from typing import Any
 
 from ..api.serving import OryxServingException
@@ -31,7 +32,10 @@ def send_input(req: Request, line: str) -> None:
     producer = req.context.get("input_producer")
     if producer is None:
         raise OryxServingException(403, "no input topic configured")
-    producer.send(None, line)
+    # key = hash of the message, so identical records land in the same
+    # partition (reference: AbstractOryxResource.sendInput :68 sends
+    # Integer.toHexString(message.hashCode()) as the key)
+    producer.send(format(zlib.crc32(line.encode("utf-8")), "x"), line)
 
 
 def _ready(req: Request):

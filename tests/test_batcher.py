@@ -142,6 +142,7 @@ def test_http_recommend_goes_through_batcher():
             "tests.test_batcher.BatcherMockManager",
         "oryx.serving.application-resources": "oryx_tpu.serving.als",
         "oryx.input-topic.broker": None,
+        "oryx.input-topic.partitions": 1,
         "oryx.input-topic.message.topic": None,
         "oryx.update-topic.broker": None,
         "oryx.update-topic.message.topic": None,

@@ -24,6 +24,7 @@ def load_server():
             "tests.test_bench_load.LoadMockManager",
         "oryx.serving.application-resources": "oryx_tpu.serving.als",
         "oryx.input-topic.broker": None,
+        "oryx.input-topic.partitions": 1,
         "oryx.input-topic.message.topic": None,
         "oryx.update-topic.broker": None,
         "oryx.update-topic.message.topic": None,

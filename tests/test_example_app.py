@@ -38,6 +38,7 @@ def test_example_full_loop(tmp_path):
     cfg = from_dict({
         "oryx.id": "ex",
         "oryx.input-topic.broker": "memory://ex-it",
+        "oryx.input-topic.partitions": 1,
         "oryx.input-topic.message.topic": "ExIn",
         "oryx.update-topic.broker": "memory://ex-it",
         "oryx.update-topic.message.topic": "ExUp",

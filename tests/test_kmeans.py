@@ -236,6 +236,7 @@ def kmeans_server():
             "tests.test_kmeans.MockKMeansManager",
         "oryx.serving.application-resources": "oryx_tpu.serving.clustering",
         "oryx.input-topic.broker": "memory://kmeans-test",
+        "oryx.input-topic.partitions": 1,
         "oryx.input-topic.message.topic": "KInput",
         "oryx.update-topic.broker": "memory://kmeans-test",
         "oryx.update-topic.message.topic": "KUpdate",

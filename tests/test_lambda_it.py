@@ -22,6 +22,7 @@ def _base_config(tmp_path, broker_name, **extra):
     overlay = {
         "oryx.id": "it",
         "oryx.input-topic.broker": f"memory://{broker_name}",
+        "oryx.input-topic.partitions": 1,
         "oryx.input-topic.message.topic": "ItInput",
         "oryx.update-topic.broker": f"memory://{broker_name}",
         "oryx.update-topic.message.topic": "ItUpdate",

@@ -168,6 +168,7 @@ def rdf_server(model_message):
             "tests.test_rdf_app.MockRDFManager",
         "oryx.serving.application-resources": "oryx_tpu.serving.classreg",
         "oryx.input-topic.broker": "memory://rdf-test",
+        "oryx.input-topic.partitions": 1,
         "oryx.input-topic.message.topic": "RInput",
         "oryx.update-topic.broker": "memory://rdf-test",
         "oryx.update-topic.message.topic": "RUpdate",
@@ -251,6 +252,7 @@ def test_train_endpoint_works_without_model(model_message):
             "tests.test_rdf_app.MockRDFManager",
         "oryx.serving.application-resources": "oryx_tpu.serving.classreg",
         "oryx.input-topic.broker": "memory://rdf-nomodel",
+        "oryx.input-topic.partitions": 1,
         "oryx.input-topic.message.topic": "RInput",
         "oryx.update-topic.broker": "memory://rdf-nomodel",
         "oryx.update-topic.message.topic": "RUpdate",

@@ -53,6 +53,7 @@ def server():
         "oryx.serving.model-manager-class": "tests.test_serving.MockALSManager",
         "oryx.serving.application-resources": "oryx_tpu.serving.als",
         "oryx.input-topic.broker": "memory://serving-test",
+        "oryx.input-topic.partitions": 1,
         "oryx.input-topic.message.topic": "TestInput",
         "oryx.update-topic.broker": None,
         "oryx.update-topic.message.topic": None,
@@ -309,5 +310,121 @@ def test_digest_auth():
         with opener.open(f"http://127.0.0.1:{layer.port}/allUserIDs",
                          timeout=10) as resp:
             assert resp.status == 200
+    finally:
+        layer.close()
+
+
+# -- consoles + HTTPS ---------------------------------------------------------
+
+def test_console_page(server):
+    """Each app serves an HTML console at the context root (reference:
+    AbstractConsoleResource per-app index.html)."""
+    body = _get(server, "/", accept="text/html")
+    assert "<!DOCTYPE html>" in body
+    assert "Alternating Least Squares" in body
+    assert "/recommend" in body
+    resp, raw = _get(server, "/", accept="text/html", raw=True)
+    assert resp.headers["Content-Type"].startswith("text/html")
+
+
+def _self_signed_pem(tmp_path):
+    """PEM cert+key via the cryptography package (test fixture only)."""
+    import datetime
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, "localhost")])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (x509.CertificateBuilder()
+            .subject_name(name).issuer_name(name)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=1))
+            .not_valid_after(now + datetime.timedelta(days=1))
+            .add_extension(x509.SubjectAlternativeName(
+                [x509.DNSName("localhost")]), critical=False)
+            .sign(key, hashes.SHA256()))
+    pem = tmp_path / "server.pem"
+    pem.write_bytes(
+        key.private_bytes(serialization.Encoding.PEM,
+                          serialization.PrivateFormat.TraditionalOpenSSL,
+                          serialization.NoEncryption())
+        + cert.public_bytes(serialization.Encoding.PEM))
+    return str(pem)
+
+
+def test_https_with_digest_auth(tmp_path):
+    """HTTPS + DIGEST together (reference: SecureAPIConfigIT.java:44;
+    connector spec ServingLayer.java:202-255)."""
+    import ssl
+    MockALSManager.model = _build_test_model()
+    pem = _self_signed_pem(tmp_path)
+    cfg = from_dict({
+        "oryx.serving.model-manager-class": "tests.test_serving.MockALSManager",
+        "oryx.serving.application-resources": "oryx_tpu.serving.als",
+        "oryx.serving.api.user-name": "oryx",
+        "oryx.serving.api.password": "pass",
+        "oryx.serving.api.keystore-file": pem,
+        "oryx.input-topic.broker": "memory://serving-test-tls",
+        "oryx.update-topic.broker": None,
+        "oryx.update-topic.message.topic": None,
+    })
+    layer = ServingLayer(cfg, port=0)
+    layer.start()
+    try:
+        assert layer.scheme == "https"
+        ctx = ssl.create_default_context()
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+        base = f"https://127.0.0.1:{layer.port}"
+        # plain HTTP against the TLS port fails at the transport level
+        with pytest.raises(Exception):
+            urllib.request.urlopen(f"http://127.0.0.1:{layer.port}/ready",
+                                   timeout=5)
+        # unauthenticated over TLS -> 401 challenge
+        try:
+            urllib.request.urlopen(
+                urllib.request.Request(base + "/allUserIDs"),
+                timeout=10, context=ctx)
+            status = 200
+        except urllib.error.HTTPError as e:
+            status = e.code
+        assert status == 401
+        # digest-authenticated over TLS -> 200 with data
+        mgr = urllib.request.HTTPPasswordMgrWithDefaultRealm()
+        mgr.add_password(None, base + "/", "oryx", "pass")
+        opener = urllib.request.build_opener(
+            urllib.request.HTTPSHandler(context=ctx),
+            urllib.request.HTTPDigestAuthHandler(mgr))
+        with opener.open(base + "/allUserIDs", timeout=10) as resp:
+            assert resp.status == 200
+            assert len(json.loads(resp.read())) == 8
+    finally:
+        layer.close()
+
+
+def test_https_secure_port_default(tmp_path):
+    """With a keystore configured and no port override, the layer binds
+    secure-port (reference: connector.setPort(securePort))."""
+    MockALSManager.model = _build_test_model()
+    pem = _self_signed_pem(tmp_path)
+    cfg = from_dict({
+        "oryx.serving.model-manager-class": "tests.test_serving.MockALSManager",
+        "oryx.serving.application-resources": "oryx_tpu.serving.als",
+        "oryx.serving.api.keystore-file": pem,
+        "oryx.serving.api.secure-port": 0,
+        "oryx.input-topic.broker": "memory://serving-test-tls2",
+        "oryx.update-topic.broker": None,
+        "oryx.update-topic.message.topic": None,
+    })
+    layer = ServingLayer(cfg)
+    assert layer.keystore_file == pem
+    layer.start()
+    try:
+        assert layer.scheme == "https"
+        assert layer.port > 0
     finally:
         layer.close()
