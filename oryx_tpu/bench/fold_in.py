@@ -1,0 +1,62 @@
+"""Fold-in throughput bench: batched vs per-event device dispatch.
+
+SURVEY §7 hard part #2: single-row "UP" updates are batch-hostile on an
+accelerator; the reference does one host solve per (user,item) event in
+a parallelStream (ALSSpeedModelManager.java:198-220).  The speed layer
+batches the whole micro-batch into one kernel (ops/als_fold_in.
+fold_in_batch); this bench records events/s for both paths so the
+speedup is a number, not a claim.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ..ops import als_fold_in, solver
+
+__all__ = ["run_fold_in_bench"]
+
+
+def run_fold_in_bench(features: int = 100, events: int = 4096,
+                      per_event_sample: int = 64, seed: int = 7) -> dict:
+    rng = np.random.default_rng(seed)
+    y = rng.standard_normal((4 * features, features)).astype(np.float32)
+    s = solver.get_solver(y.T @ y)
+    values = (rng.exponential(1.0, events) + 0.1).astype(np.float32)
+    xu = (rng.standard_normal((events, features)) * 0.2).astype(np.float32)
+    yi = rng.standard_normal((events, features)).astype(np.float32)
+
+    # warm both paths (compile)
+    als_fold_in.fold_in_batch(s, values[:8], xu[:8], yi[:8], implicit=True)
+    als_fold_in.compute_updated_xu(s, float(values[0]), xu[0], yi[0], True)
+
+    t0 = time.perf_counter()
+    new_xu, valid = als_fold_in.fold_in_batch(s, values, xu, yi,
+                                              implicit=True)
+    batch_s = time.perf_counter() - t0
+    # events whose current estimate already exceeds the target fold to
+    # "no change" (NaN target) — legitimate, just not counted invalid
+    assert np.isfinite(new_xu).all()
+
+    t0 = time.perf_counter()
+    for i in range(per_event_sample):
+        als_fold_in.compute_updated_xu(s, float(values[i]), xu[i], yi[i],
+                                       True)
+    per_event_s = (time.perf_counter() - t0) / per_event_sample
+
+    batched_eps = events / batch_s
+    single_eps = 1.0 / per_event_s
+    return {
+        "features": features,
+        "events": events,
+        "batched_events_per_s": round(batched_eps, 1),
+        "per_event_dispatch_events_per_s": round(single_eps, 1),
+        "speedup": round(batched_eps / single_eps, 1),
+    }
+
+
+if __name__ == "__main__":
+    import json
+    print(json.dumps(run_fold_in_bench()))

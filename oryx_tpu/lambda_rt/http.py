@@ -20,6 +20,7 @@ import json
 import re
 import secrets
 import threading
+import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, NamedTuple
@@ -119,6 +120,9 @@ class HttpApp:
                  context_path: str = "/"):
         self._routes = [(r, _compile(r.pattern)) for r in routes]
         self.context = context
+        # single injection point: the dispatcher records into the same
+        # registry the /metrics endpoint reads from the context
+        self.metrics = context.get("metrics")
         self.read_only = read_only
         self.user_name = user_name
         self.password = password
@@ -162,6 +166,7 @@ class HttpApp:
             if len(self._nonces) > 10000:
                 self._nonces.clear()
                 self._nonces.add(nonce)
+        handler._oryx_status = 401
         handler.send_response(401)
         handler.send_header(
             "WWW-Authenticate",
@@ -173,10 +178,22 @@ class HttpApp:
     # -- dispatch ------------------------------------------------------------
 
     def handle(self, handler: BaseHTTPRequestHandler) -> None:
+        t0 = time.perf_counter()
+        handler._oryx_route = None
+        handler._oryx_status = 0
         try:
             self._handle(handler)
         except BrokenPipeError:  # client went away
             pass
+        finally:
+            if self.metrics is not None:
+                # unmatched paths pool under one bucket so scanners
+                # can't grow the registry unboundedly; status 0 means
+                # the request died before any response was written
+                # (counted as an error by the registry)
+                self.metrics.record(handler._oryx_route or "unmatched",
+                                    handler._oryx_status,
+                                    time.perf_counter() - t0)
 
     def _handle(self, handler: BaseHTTPRequestHandler) -> None:
         if not self._auth_ok(handler):
@@ -198,13 +215,19 @@ class HttpApp:
             matched_path = True
             if route.method != lookup_method:
                 continue
+            handler._oryx_route = f"{route.method} {route.pattern}"
             if route.mutates and self.read_only:
                 self._send_error(handler, 403, "endpoint is read-only")
                 return
             length = int(handler.headers.get("Content-Length") or 0)
             body = handler.rfile.read(length) if length else b""
             if handler.headers.get("Content-Encoding", "") == "gzip" and body:
-                body = gzip.decompress(body)
+                try:
+                    body = gzip.decompress(body)
+                except (gzip.BadGzipFile, OSError, EOFError):
+                    self._send_error(handler, 400,
+                                     "Content-Encoding gzip but body is not")
+                    return
             req = Request(method, path, m.groupdict(), query, body,
                           dict(handler.headers), self.context)
             try:
@@ -234,9 +257,12 @@ class HttpApp:
                 and isinstance(result[0], int):
             status, result = result
         if result is None:
-            handler.send_response(status if status != 200 else 204)
+            status = status if status != 200 else 204
+            handler._oryx_status = status
+            handler.send_response(status)
             handler.end_headers()
             return
+        handler._oryx_status = status
         payload, ctype = json_or_csv(result, accept)
         handler.send_response(status)
         handler.send_header("Content-Type", ctype)
@@ -256,6 +282,7 @@ class HttpApp:
 
     def _send_error(self, handler, status: int, message: str) -> None:
         # uniform plain-text error page (reference: ErrorResource.java:36)
+        handler._oryx_status = status
         payload = f"HTTP {status}\n{message}\n".encode()
         handler.send_response(status)
         handler.send_header("Content-Type", "text/plain")

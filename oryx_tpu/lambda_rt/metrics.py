@@ -1,0 +1,82 @@
+"""Request metrics registry for the serving layer.
+
+The reference's observability is logs + the Spark UI (SURVEY §5.1/5.5 —
+no metrics registry exists); ops parity for a TPU-native stack needs at
+least request counts and latency percentiles per endpoint.  This is a
+minimal thread-safe registry: per-route counters plus a bounded
+latency reservoir (ring buffer), surfaced by the ``/metrics`` endpoint
+(serving/framework.py) and usable from bench harnesses.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+__all__ = ["MetricsRegistry"]
+
+# per-route latency ring-buffer capacity; percentiles reflect the most
+# recent window, counters are cumulative
+_RESERVOIR = 8192
+
+
+class _RouteStats:
+    __slots__ = ("count", "errors", "total_ms", "latencies", "pos", "filled")
+
+    def __init__(self):
+        self.count = 0
+        self.errors = 0
+        self.total_ms = 0.0
+        self.latencies = np.zeros(_RESERVOIR, dtype=np.float32)
+        self.pos = 0
+        self.filled = False
+
+    def record(self, status: int, ms: float) -> None:
+        self.count += 1
+        # status 0 = connection died before a response was written
+        if status >= 400 or status == 0:
+            self.errors += 1
+        self.total_ms += ms
+        self.latencies[self.pos] = ms
+        self.pos += 1
+        if self.pos >= _RESERVOIR:
+            self.pos = 0
+            self.filled = True
+
+    def snapshot(self) -> dict:
+        window = self.latencies[:self.pos] if not self.filled \
+            else self.latencies
+        out = {
+            "count": self.count,
+            "errors": self.errors,
+            "mean_ms": round(self.total_ms / self.count, 3)
+            if self.count else 0.0,
+        }
+        if len(window):
+            p50, p95, p99 = np.percentile(window, (50, 95, 99))
+            out.update(p50_ms=round(float(p50), 3),
+                       p95_ms=round(float(p95), 3),
+                       p99_ms=round(float(p99), 3))
+        return out
+
+
+class MetricsRegistry:
+    """Thread-safe per-route request stats."""
+
+    def __init__(self):
+        self._routes: dict[str, _RouteStats] = {}
+        self._lock = threading.Lock()
+
+    def record(self, route: str, status: int, seconds: float) -> None:
+        with self._lock:
+            stats = self._routes.get(route)
+            if stats is None:
+                stats = self._routes[route] = _RouteStats()
+            stats.record(status, seconds * 1000.0)
+
+    def snapshot(self) -> dict:
+        """{route: {count, errors, mean_ms, p50_ms, p95_ms, p99_ms}}"""
+        with self._lock:
+            return {route: stats.snapshot()
+                    for route, stats in sorted(self._routes.items())}

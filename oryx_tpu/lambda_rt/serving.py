@@ -21,6 +21,7 @@ from ..kafka import utils as kafka_utils
 from ..kafka.inproc import InProcTopicProducer, resolve_broker
 from ..serving.batcher import TopNBatcher
 from .http import HttpApp, Route, make_server
+from .metrics import MetricsRegistry
 
 _log = logging.getLogger(__name__)
 
@@ -80,6 +81,7 @@ class ServingLayer:
 
         routes = self._discover_routes()
         self.top_n_batcher = TopNBatcher()
+        self.metrics = MetricsRegistry()
         self.app = HttpApp(
             routes,
             context={
@@ -88,6 +90,7 @@ class ServingLayer:
                 "config": config,
                 "min_model_load_fraction": self.min_model_load_fraction,
                 "top_n_batcher": self.top_n_batcher,
+                "metrics": self.metrics,
             },
             read_only=self.read_only,
             user_name=self.user_name,

@@ -372,26 +372,61 @@ def _pref_delete(req: Request):
     return None
 
 
+def _decode_ingest_payload(data: bytes, ctype: str, filename: str) -> str:
+    """One uploaded payload -> text, sniffing gzip/zip from the content
+    type or filename (reference: Ingest.java maybeDecompress by part
+    content type and file extension)."""
+    if "gzip" in ctype or filename.endswith(".gz"):
+        try:
+            return gzip.decompress(data).decode()
+        except gzip.BadGzipFile:
+            # transport layer may have already decoded Content-Encoding
+            return data.decode()
+    if "zip" in ctype or filename.endswith(".zip"):
+        texts = []
+        with zipfile.ZipFile(io.BytesIO(data)) as zf:
+            for name in zf.namelist():
+                texts.append(zf.read(name).decode())
+        return "\n".join(texts)
+    return data.decode()
+
+
+def _multipart_texts(body: bytes, ctype: str) -> list[str]:
+    """Decode every file part of a multipart/form-data body, each part
+    independently gzip/zip-sniffed (reference: Ingest.java:61-... via
+    the servlet fileupload parser)."""
+    import email
+    import email.policy
+
+    msg = email.message_from_bytes(
+        b"Content-Type: " + ctype.encode("utf-8") + b"\r\n\r\n" + body,
+        policy=email.policy.default)
+    if not msg.is_multipart():
+        raise OryxServingException(400, "bad multipart body")
+    texts = []
+    for part in msg.iter_parts():
+        data = part.get_payload(decode=True)
+        if data is None:
+            continue
+        texts.append(_decode_ingest_payload(
+            data, part.get_content_type(), part.get_filename() or ""))
+    if not texts:
+        raise OryxServingException(400, "no file parts in multipart body")
+    return texts
+
+
 def _ingest(req: Request):
-    """Bulk CSV ingest; accepts plain, gzip, or zip bodies
+    """Bulk CSV ingest; accepts plain, gzip, or zip bodies, and
+    multipart/form-data uploads whose parts are each plain/gzip/zip
     (reference: Ingest.java:61-...)."""
     body = req.body
     ctype = req.headers.get("Content-Type", "")
     encoding = req.headers.get("Content-Encoding", "")
-    if "gzip" in ctype or "gzip" in encoding:
-        try:
-            text = gzip.decompress(body).decode()
-        except gzip.BadGzipFile:
-            # transport layer may have already decoded Content-Encoding
-            text = body.decode()
-    elif "zip" in ctype or "zip" in encoding:
-        texts = []
-        with zipfile.ZipFile(io.BytesIO(body)) as zf:
-            for name in zf.namelist():
-                texts.append(zf.read(name).decode())
-        text = "\n".join(texts)
+    if ctype.startswith("multipart/form-data"):
+        text = "\n".join(_multipart_texts(body, ctype))
     else:
-        text = body.decode()
+        # content type OR transfer encoding may declare the compression
+        text = _decode_ingest_payload(body, f"{ctype} {encoding}", "")
     # validate the whole (already fully buffered) body before sending
     # anything, so a bad line can't leave a partial ingest behind
     lines = [ln.strip() for ln in text.splitlines() if ln.strip()]

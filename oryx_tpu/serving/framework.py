@@ -47,6 +47,22 @@ def _ready(req: Request):
     raise OryxServingException(503, "Model not available yet")
 
 
+def _metrics(req: Request):
+    """Per-route request counts, error counts, and latency percentiles
+    (the reference exposes only logs + Spark UI — SURVEY §5.1/5.5; this
+    is the serving-side step-metrics surface ops parity needs)."""
+    registry = req.context.get("metrics")
+    if registry is None:
+        raise OryxServingException(404, "metrics not enabled")
+    model = req.context["model_manager"].get_model()
+    return {
+        "routes": registry.snapshot(),
+        "model_fraction_loaded":
+            model.get_fraction_loaded() if model is not None else 0.0,
+    }
+
+
 ROUTES = [
     Route("GET", "/ready", _ready),
+    Route("GET", "/metrics", _metrics),
 ]

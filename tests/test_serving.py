@@ -428,3 +428,53 @@ def test_https_secure_port_default(tmp_path):
         assert layer.port > 0
     finally:
         layer.close()
+
+
+def test_ingest_multipart(server):
+    """multipart/form-data ingest with a plain part and a gzipped part
+    (reference: Ingest.java:61 accepts multipart file uploads)."""
+    broker = get_broker("serving-test")
+    start = broker.latest_offset("TestInput")
+    boundary = "testboundary42"
+    part1 = b"U6,I1,1\nU6,I2,2.0\n"
+    part2 = gzip.compress(b"U7,I3,1\n")
+    body = (
+        f"--{boundary}\r\n"
+        f'Content-Disposition: form-data; name="a"; filename="a.csv"\r\n'
+        f"Content-Type: text/csv\r\n\r\n").encode() + part1 + (
+        f"\r\n--{boundary}\r\n"
+        f'Content-Disposition: form-data; name="b"; filename="b.csv.gz"\r\n'
+        f"Content-Type: application/octet-stream\r\n"
+        f"Content-Transfer-Encoding: binary\r\n\r\n").encode() + part2 + (
+        f"\r\n--{boundary}--\r\n").encode()
+    st = _status_of(server, "/ingest", method="POST", data=body, headers={
+        "Content-Type": f"multipart/form-data; boundary={boundary}"})
+    assert st == 200
+    end = broker.latest_offset("TestInput")
+    got = sorted(km.message
+                 for km in broker.read_range("TestInput", start, end))
+    assert got == ["U6,I1,1", "U6,I2,2.0", "U7,I3,1"]
+
+
+def test_ingest_multipart_no_parts_400(server):
+    boundary = "emptyb"
+    body = f"--{boundary}--\r\n".encode()
+    st = _status_of(server, "/ingest", method="POST", data=body, headers={
+        "Content-Type": f"multipart/form-data; boundary={boundary}"})
+    assert st == 400
+
+
+def test_metrics_endpoint(server):
+    """/metrics exposes per-route counts and latency percentiles
+    (ops parity for the reference's Spark-UI observability)."""
+    for _ in range(3):
+        _get(server, "/recommend/U2?howMany=2")
+    _status_of(server, "/recommend/nobody")  # 404 counted as error
+    m = _get(server, "/metrics")
+    assert set(m) == {"routes", "model_fraction_loaded"}
+    rec = m["routes"]["GET /recommend/{userID}"]
+    assert rec["count"] >= 4
+    assert rec["errors"] >= 1
+    assert rec["p50_ms"] > 0
+    assert rec["p95_ms"] >= rec["p50_ms"]
+    assert m["model_fraction_loaded"] == 1.0
