@@ -274,3 +274,94 @@ def test_stale_single_partition_writer_lands_in_p0(tmp_path):
     got = late.read_ranges("In", [0] * 4, ends)
     assert sorted(km.message for km in got) == [f"m{i}" for i in range(8)]
     late.close()
+
+
+# -- broker contract suite, parametrized over implementations ----------------
+#
+# The same offset/replay contract must hold for the in-proc broker and
+# the optional real-Kafka binding (reference: KafkaUtils.java:63-181).
+# The kafka case skips unless kafka-python is importable AND a broker
+# answers at KAFKA_TEST_BOOTSTRAP (default localhost:9092).
+
+def _kafka_test_broker():
+    import os
+    import socket
+    from oryx_tpu.kafka.client import (get_kafka_broker,
+                                       kafka_client_available)
+    if not kafka_client_available():
+        pytest.skip("kafka-python not installed")
+    bootstrap = os.environ.get("KAFKA_TEST_BOOTSTRAP", "localhost:9092")
+    host, _, port = bootstrap.partition(":")
+    try:
+        socket.create_connection((host, int(port or 9092)), 1).close()
+    except OSError:
+        pytest.skip(f"no Kafka broker reachable at {bootstrap}")
+    return get_kafka_broker(bootstrap)
+
+
+@pytest.fixture(params=["inproc", "kafka"])
+def any_broker(request):
+    if request.param == "kafka":
+        yield _kafka_test_broker()
+    else:
+        yield InProcBroker("contract-" + str(time.monotonic_ns()))
+
+
+@pytest.fixture
+def contract_topic(any_broker):
+    topic = "ct-" + str(time.monotonic_ns())
+    any_broker.create_topic(topic, partitions=1)
+    yield topic
+    any_broker.delete_topic(topic)
+
+
+def test_contract_produce_consume_replay(any_broker, contract_topic):
+    t = contract_topic
+    any_broker.send(t, KEY_MODEL, "<PMML/>")
+    any_broker.send(t, KEY_UP, '["X","u1",[0.1]]')
+    got = list(any_broker.consume(t, from_beginning=True, max_idle_sec=1.0))
+    assert [(m.key, m.message) for m in got] == \
+        [(KEY_MODEL, "<PMML/>"), (KEY_UP, '["X","u1",[0.1]]')]
+
+
+def test_contract_group_offsets_commit_and_resume(any_broker, contract_topic):
+    t = contract_topic
+    for i in range(5):
+        any_broker.send(t, None, f"m{i}")
+    group = "g-" + t
+    first = []
+    for km in any_broker.consume(t, group=group, from_beginning=True,
+                                 max_idle_sec=1.0):
+        first.append(km.message)
+        if len(first) == 3:
+            break
+    assert first == ["m0", "m1", "m2"]
+    # m2 was in-flight when the consumer broke: at-least-once redelivers
+    rest = [km.message for km in any_broker.consume(t, group=group,
+                                                    max_idle_sec=1.0)]
+    assert rest == ["m2", "m3", "m4"]
+
+
+def test_contract_fill_in_latest(any_broker, contract_topic):
+    t = contract_topic
+    any_broker.send(t, None, "a")
+    any_broker.send(t, None, "b")
+    group = "g-" + t
+    any_broker.fill_in_latest_offsets(group, [t])
+    assert any_broker.get_offsets(group, t) == any_broker.latest_offsets(t)
+    out = [km.message for km in any_broker.consume(t, group=group,
+                                                   max_idle_sec=1.0)]
+    assert out == []  # starts from now
+
+
+def test_contract_vector_offset_roundtrip(any_broker, contract_topic):
+    t = contract_topic
+    for i in range(4):
+        any_broker.send(t, f"k{i}", f"m{i}")
+    ends = any_broker.latest_offsets(t)
+    assert sum(ends) == 4
+    group = "g-" + t
+    any_broker.set_offsets(group, t, ends)
+    assert any_broker.get_offsets(group, t) == ends
+    got = any_broker.read_ranges(t, [0] * len(ends), ends)
+    assert sorted(km.message for km in got) == [f"m{i}" for i in range(4)]
