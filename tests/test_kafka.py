@@ -25,49 +25,14 @@ def test_topic_admin(broker):
     assert not broker.topic_exists("t")
 
 
-def test_produce_consume_from_beginning(broker):
-    broker.send("t", KEY_MODEL, "<PMML/>")
-    broker.send("t", KEY_UP, '["X","u1",[0.1]]')
-    got = []
-    stop = threading.Event()
-    for km in broker.consume("t", from_beginning=True, stop=stop,
-                             max_idle_sec=0.2):
-        got.append(km)
-        if len(got) == 2:
-            stop.set()
-    assert got == [KeyMessage(KEY_MODEL, "<PMML/>"),
-                   KeyMessage(KEY_UP, '["X","u1",[0.1]]')]
-
-
 def test_consume_latest_skips_history(broker):
     broker.send("t", None, "old")
     out = list(broker.consume("t", max_idle_sec=0.1))
     assert out == []
 
 
-def test_group_offsets_resume(broker):
-    for i in range(5):
-        broker.send("t", None, f"m{i}")
-    first = []
-    for km in broker.consume("t", group="g", from_beginning=True, max_idle_sec=0.1):
-        first.append(km.message)
-        if len(first) == 3:
-            break
-    assert first == ["m0", "m1", "m2"]
-    # a new consumer in the same group resumes from the last COMMITTED
-    # message: m2 was in flight when the first consumer broke, so
-    # at-least-once redelivers it (duplicates possible, loss impossible)
-    rest = [km.message for km in broker.consume("t", group="g", max_idle_sec=0.1)]
-    assert rest == ["m2", "m3", "m4"]
-
-
-def test_fill_in_latest_offsets(broker):
-    broker.send("t", None, "a")
-    broker.send("t", None, "b")
-    broker.fill_in_latest_offsets("g", ["t"])
-    assert broker.get_offset("g", "t") == 2
-    out = [km.message for km in broker.consume("t", group="g", max_idle_sec=0.1)]
-    assert out == []  # starts from now
+# produce/replay, group-offset resume and fill-in-latest are covered by
+# the binding-parametrized contract suite at the bottom of this file
 
 
 def test_blocking_consumer_sees_live_messages(broker):
@@ -291,10 +256,13 @@ def _kafka_test_broker():
     if not kafka_client_available():
         pytest.skip("kafka-python not installed")
     bootstrap = os.environ.get("KAFKA_TEST_BOOTSTRAP", "localhost:9092")
-    host, _, port = bootstrap.partition(":")
+    # first entry of a possibly multi-host bootstrap list; a malformed
+    # value skips rather than erroring the suite
+    first = bootstrap.split(",")[0]
+    host, _, port = first.partition(":")
     try:
         socket.create_connection((host, int(port or 9092)), 1).close()
-    except OSError:
+    except (OSError, ValueError):
         pytest.skip(f"no Kafka broker reachable at {bootstrap}")
     return get_kafka_broker(bootstrap)
 
@@ -302,66 +270,74 @@ def _kafka_test_broker():
 @pytest.fixture(params=["inproc", "kafka"])
 def any_broker(request):
     if request.param == "kafka":
-        yield _kafka_test_broker()
+        # real broker: group join/rebalance takes seconds on a default
+        # broker config (group.initial.rebalance.delay.ms=3000), so the
+        # consume idle window must comfortably exceed it
+        yield _kafka_test_broker(), 10.0
     else:
-        yield InProcBroker("contract-" + str(time.monotonic_ns()))
+        yield (InProcBroker("contract-" + str(time.monotonic_ns())), 0.2)
 
 
 @pytest.fixture
 def contract_topic(any_broker):
+    b, _ = any_broker
     topic = "ct-" + str(time.monotonic_ns())
-    any_broker.create_topic(topic, partitions=1)
+    b.create_topic(topic, partitions=1)
     yield topic
-    any_broker.delete_topic(topic)
+    b.delete_topic(topic)
 
 
 def test_contract_produce_consume_replay(any_broker, contract_topic):
+    b, idle = any_broker
     t = contract_topic
-    any_broker.send(t, KEY_MODEL, "<PMML/>")
-    any_broker.send(t, KEY_UP, '["X","u1",[0.1]]')
-    got = list(any_broker.consume(t, from_beginning=True, max_idle_sec=1.0))
+    b.send(t, KEY_MODEL, "<PMML/>")
+    b.send(t, KEY_UP, '["X","u1",[0.1]]')
+    got = list(b.consume(t, from_beginning=True, max_idle_sec=idle))
     assert [(m.key, m.message) for m in got] == \
         [(KEY_MODEL, "<PMML/>"), (KEY_UP, '["X","u1",[0.1]]')]
 
 
 def test_contract_group_offsets_commit_and_resume(any_broker, contract_topic):
+    b, idle = any_broker
     t = contract_topic
     for i in range(5):
-        any_broker.send(t, None, f"m{i}")
+        b.send(t, None, f"m{i}")
     group = "g-" + t
     first = []
-    for km in any_broker.consume(t, group=group, from_beginning=True,
-                                 max_idle_sec=1.0):
+    for km in b.consume(t, group=group, from_beginning=True,
+                        max_idle_sec=idle):
         first.append(km.message)
         if len(first) == 3:
             break
     assert first == ["m0", "m1", "m2"]
     # m2 was in-flight when the consumer broke: at-least-once redelivers
-    rest = [km.message for km in any_broker.consume(t, group=group,
-                                                    max_idle_sec=1.0)]
+    rest = [km.message for km in b.consume(t, group=group,
+                                           max_idle_sec=idle)]
     assert rest == ["m2", "m3", "m4"]
 
 
 def test_contract_fill_in_latest(any_broker, contract_topic):
+    b, idle = any_broker
     t = contract_topic
-    any_broker.send(t, None, "a")
-    any_broker.send(t, None, "b")
+    b.send(t, None, "a")
+    b.send(t, None, "b")
     group = "g-" + t
-    any_broker.fill_in_latest_offsets(group, [t])
-    assert any_broker.get_offsets(group, t) == any_broker.latest_offsets(t)
-    out = [km.message for km in any_broker.consume(t, group=group,
-                                                   max_idle_sec=1.0)]
+    b.fill_in_latest_offsets(group, [t])
+    assert b.get_offsets(group, t) == b.latest_offsets(t)
+    out = [km.message for km in b.consume(t, group=group,
+                                          max_idle_sec=idle)]
     assert out == []  # starts from now
 
 
 def test_contract_vector_offset_roundtrip(any_broker, contract_topic):
+    b, _ = any_broker
     t = contract_topic
     for i in range(4):
-        any_broker.send(t, f"k{i}", f"m{i}")
-    ends = any_broker.latest_offsets(t)
+        b.send(t, f"k{i}", f"m{i}")
+    ends = b.latest_offsets(t)
     assert sum(ends) == 4
     group = "g-" + t
-    any_broker.set_offsets(group, t, ends)
-    assert any_broker.get_offsets(group, t) == ends
-    got = any_broker.read_ranges(t, [0] * len(ends), ends)
+    b.set_offsets(group, t, ends)
+    assert b.get_offsets(group, t) == ends
+    got = b.read_ranges(t, [0] * len(ends), ends)
     assert sorted(km.message for km in got) == [f"m{i}" for i in range(4)]
