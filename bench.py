@@ -72,12 +72,10 @@ def main() -> None:
         assert len(out) == KERNEL_BATCH and len(out[0]) == TOP_N
     kernel_qps = KERNEL_BATCHES * KERNEL_BATCH / (time.perf_counter() - t0)
 
-    # live HTTP through the real serving stack.  Pipeline depth 8 keeps
-    # eight batched dispatches in flight — measured sweet spot for the
-    # high-latency tunneled chip (4: batches coalesce well but stall on
-    # the round trip; 16: batches fragment below the dispatch overhead)
+    # live HTTP through the real serving stack, at the serving layer's
+    # default batcher configuration
     StaticModelManager.model = model
-    batcher = TopNBatcher(pipeline=8)
+    batcher = TopNBatcher()
     app = HttpApp(
         framework_resources.ROUTES + als_resources.ROUTES,
         context={

@@ -20,18 +20,13 @@ __all__ = [
     "input_topic_partitions",
 ]
 
-# reference oryx-run.sh:343 creates the input topic with 4 partitions
-DEFAULT_INPUT_PARTITIONS = 4
-
-
 def input_topic_partitions(config) -> int:
-    """Configured input-topic partition count (oryx.input-topic.
-    partitions, defaulting to the reference's 4) — every component that
+    """Configured input-topic partition count — every component that
     might create the input topic must use this so first-creator races
-    can't freeze the topic at one partition."""
-    if config.has_path("oryx.input-topic.partitions"):
-        return config.get_int("oryx.input-topic.partitions")
-    return DEFAULT_INPUT_PARTITIONS
+    can't freeze the topic at one partition.  The single source of
+    truth is ``oryx.input-topic.partitions`` in reference.conf (4, the
+    count oryx-run.sh:343 uses), merged into every Config."""
+    return config.get_int("oryx.input-topic.partitions")
 
 
 def maybe_create_topic(broker_uri: str, topic: str, partitions: int = 1) -> None:

@@ -80,7 +80,8 @@ class ServingLayer:
                                                       self.input_topic)
 
         routes = self._discover_routes()
-        self.top_n_batcher = TopNBatcher()
+        self.top_n_batcher = TopNBatcher(
+            pipeline=config.get_int(f"{api}.scoring-pipeline-depth"))
         self.metrics = MetricsRegistry()
         self.app = HttpApp(
             routes,

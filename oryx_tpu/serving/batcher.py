@@ -45,11 +45,14 @@ class TopNBatcher:
     device calls.  Safe across model hot-swaps: jobs carry their model,
     and each drain groups jobs by model identity."""
 
-    def __init__(self, max_batch: int = 1024, pipeline: int = 4):
+    def __init__(self, max_batch: int = 1024, pipeline: int = 8):
         """``pipeline`` dispatcher threads keep that many batched device
         calls in flight at once: dispatch latency (dominated by the
         host<->device round trip) overlaps instead of serializing, so
-        sustained throughput ~= mean_batch x pipeline / round_trip."""
+        sustained throughput ~= mean_batch x pipeline / round_trip.
+        Depth 8 is the measured sweet spot on a single chip (4 stalls on
+        the round trip, 16 fragments batches below dispatch overhead);
+        configurable via oryx.serving.api.scoring-pipeline-depth."""
         self.max_batch = max_batch
         self._cond = threading.Condition()
         self._pending: list[_Job] = []

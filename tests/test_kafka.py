@@ -341,3 +341,21 @@ def test_contract_vector_offset_roundtrip(any_broker, contract_topic):
     assert b.get_offsets(group, t) == ends
     got = b.read_ranges(t, [0] * len(ends), ends)
     assert sorted(km.message for km in got) == [f"m{i}" for i in range(4)]
+
+
+# -- synthetic producers / tailers (ProduceData / ConsumeTopic) ---------------
+
+def test_produce_data_and_consume_topic():
+    from oryx_tpu.kafka.produce import (ConsumeTopic, ProduceData,
+                                        csv_datum_generator)
+    uri = "memory://produce-" + str(time.monotonic_ns())
+    tail = ConsumeTopic(uri, "T").start()
+    n = ProduceData(csv_datum_generator(3), uri, "T", how_many=25).start()
+    assert n == 25
+    assert tail.await_count(25)
+    got = tail.close()
+    assert len(got) == 25
+    # CSV shape: id,bool,float
+    fields = got[0].message.split(",")
+    assert fields[0] == "0" and fields[1] in ("true", "false")
+    float(fields[2])
