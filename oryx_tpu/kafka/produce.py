@@ -3,9 +3,9 @@
 Reference: framework/kafka-util test scope — DatumGenerator.java (one
 (key, message) per id), ProduceData.java:36 (continually send random
 CSV data to a topic), ConsumeData.java:29 / ConsumeDataIterator and
-ConsumeTopicRunnable (tail a topic collecting messages).  Used by
-integration tests and the ``kafka-input`` CLI to drive pipelines with
-synthetic traffic.
+ConsumeTopicRunnable (tail a topic collecting messages).  Test/ops
+infrastructure for driving pipelines with synthetic traffic (the
+``kafka-input`` CLI streams real files and does not use these).
 """
 
 from __future__ import annotations

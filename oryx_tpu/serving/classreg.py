@@ -88,21 +88,31 @@ def _classification_distribution(req: Request):
             for i, p in enumerate(prediction.category_probabilities)]
 
 
+def _predictor_importances(model: RDFServingModel) -> list[float]:
+    """Importances indexed by PREDICTOR number (reference:
+    RDFUpdate.countsToImportances sizes by getNumPredictors, so
+    /feature/importance/{n} takes a predictor index — the target column
+    is not a feature here).  The forest stores them all-features-indexed
+    for PMML round-tripping; project down through the schema."""
+    schema = model.input_schema
+    imps = model.forest.feature_importances
+    return [float(imps[schema.predictor_to_feature_index(p)])
+            for p in range(schema.num_predictors)]
+
+
 def _feature_importance_all(req: Request):
-    model = _rdf_model(req)
-    return [float(v) for v in model.forest.feature_importances]
+    return _predictor_importances(_rdf_model(req))
 
 
 def _feature_importance_one(req: Request):
-    model = _rdf_model(req)
-    importances = model.forest.feature_importances
+    importances = _predictor_importances(_rdf_model(req))
     try:
         number = int(req.params["featureNumber"])
     except ValueError:
         raise OryxServingException(400, "Bad feature number")
     if not 0 <= number < len(importances):
         raise OryxServingException(400, "Bad feature number")
-    return float(importances[number])
+    return importances[number]
 
 
 ROUTES = [

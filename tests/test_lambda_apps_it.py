@@ -1,0 +1,171 @@
+"""Full lambda-loop integration for the k-means and RDF app families,
+plus a real hyperparameter-tuning run through ALSUpdate.
+
+Reference analogs: KMeansUpdateIT / RDFUpdateIT (full batch build over
+a local cluster, assert published model + update-topic traffic) and
+ALSHyperParamTuningIT.java:36 (grid of candidates, best model wins).
+The ALS full loop lives in test_lambda_it.py; these cover the other
+two app families end-to-end over the in-proc broker: input topic ->
+BatchLayer generation -> MODEL on the update topic -> ServingLayer
+replay -> live REST answers.
+"""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+
+from oryx_tpu.common.config import from_dict
+from oryx_tpu.kafka.api import KEY_MODEL
+from oryx_tpu.kafka.inproc import get_broker
+from oryx_tpu.lambda_rt.batch import BatchLayer
+from oryx_tpu.lambda_rt.serving import ServingLayer
+
+
+def _await_model(serving, min_fraction=0.8, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        model = serving.model_manager.get_model()
+        if model is not None and model.get_fraction_loaded() >= min_fraction:
+            return model
+        time.sleep(0.05)
+    raise AssertionError("serving model never loaded")
+
+
+def _get(serving, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{serving.port}{path}", timeout=10) as r:
+        return json.loads(r.read())
+
+
+def test_kmeans_full_loop(tmp_path):
+    cfg = from_dict({
+        "oryx.id": "kmit",
+        "oryx.input-topic.broker": "memory://kmit",
+        "oryx.input-topic.partitions": 1,
+        "oryx.input-topic.message.topic": "KmIn",
+        "oryx.update-topic.broker": "memory://kmit",
+        "oryx.update-topic.message.topic": "KmUp",
+        "oryx.batch.update-class": "oryx_tpu.app.kmeans.update.KMeansUpdate",
+        "oryx.batch.storage.data-dir": str(tmp_path / "data"),
+        "oryx.batch.storage.model-dir": str(tmp_path / "model"),
+        "oryx.serving.model-manager-class":
+            "oryx_tpu.app.kmeans.serving.KMeansServingModelManager",
+        "oryx.serving.application-resources": "oryx_tpu.serving.clustering",
+        "oryx.kmeans.hyperparams.k": 3,
+        "oryx.input-schema.num-features": 2,
+        "oryx.input-schema.numeric-features": ["0", "1"],
+        "oryx.ml.eval.test-fraction": 0.2,
+    })
+    broker = get_broker("kmit")
+    rng = np.random.default_rng(11)
+    centers = np.array([[0.0, 0.0], [8.0, 8.0], [-8.0, 8.0]])
+    for i in range(300):
+        c = centers[i % 3] + rng.standard_normal(2) * 0.4
+        broker.send("KmIn", None, f"{c[0]:.3f},{c[1]:.3f}")
+
+    BatchLayer(cfg).run_one_generation()
+    msgs = list(broker.consume("KmUp", from_beginning=True, max_idle_sec=0.2))
+    assert msgs and msgs[0].key == KEY_MODEL
+    assert "ClusteringModel" in msgs[0].message
+
+    serving = ServingLayer(cfg, port=0)
+    serving.start()
+    try:
+        _await_model(serving)
+        # points near each true center land in three distinct clusters
+        assigns = {int(_get(serving, f"/assign/{x},{y}"))
+                   for x, y in [(0, 0), (8, 8), (-8, 8)]}
+        assert len(assigns) == 3
+        d = float(_get(serving, "/distanceToNearest/0.1,0.1"))
+        assert d < 2.0
+    finally:
+        serving.close()
+
+
+def test_rdf_full_loop(tmp_path):
+    cfg = from_dict({
+        "oryx.id": "rdfit",
+        "oryx.input-topic.broker": "memory://rdfit",
+        "oryx.input-topic.partitions": 1,
+        "oryx.input-topic.message.topic": "RdfIn",
+        "oryx.update-topic.broker": "memory://rdfit",
+        "oryx.update-topic.message.topic": "RdfUp",
+        "oryx.batch.update-class": "oryx_tpu.app.rdf.update.RDFUpdate",
+        "oryx.batch.storage.data-dir": str(tmp_path / "data"),
+        "oryx.batch.storage.model-dir": str(tmp_path / "model"),
+        "oryx.serving.model-manager-class":
+            "oryx_tpu.app.rdf.serving.RDFServingModelManager",
+        "oryx.serving.application-resources": "oryx_tpu.serving.classreg",
+        "oryx.rdf.num-trees": 5,
+        "oryx.input-schema.feature-names": ["a", "b", "label"],
+        "oryx.input-schema.numeric-features": ["a", "b"],
+        "oryx.input-schema.target-feature": "label",
+        "oryx.ml.eval.test-fraction": 0.2,
+    })
+    broker = get_broker("rdfit")
+    rng = np.random.default_rng(13)
+    for _ in range(400):
+        a, b = rng.uniform(-1, 1, 2)
+        label = "pos" if a + 0.5 * b > 0 else "neg"
+        broker.send("RdfIn", None, f"{a:.3f},{b:.3f},{label}")
+
+    BatchLayer(cfg).run_one_generation()
+    msgs = list(broker.consume("RdfUp", from_beginning=True, max_idle_sec=0.2))
+    assert msgs and msgs[0].key == KEY_MODEL
+    assert "MiningModel" in msgs[0].message or "TreeModel" in msgs[0].message
+
+    serving = ServingLayer(cfg, port=0)
+    serving.start()
+    try:
+        _await_model(serving)
+        # trailing comma = empty target slot (reference datum format)
+        assert _get(serving, "/predict/0.9,0.4,") == "pos"
+        assert _get(serving, "/predict/-0.9,-0.4,") == "neg"
+        dist = _get(serving, "/classificationDistribution/0.9,0.4,")
+        probs = {d["id"]: d["value"] for d in dist}
+        assert probs["pos"] > probs["neg"]
+        importances = _get(serving, "/feature/importance")
+        assert len(importances) == 2  # two predictors
+    finally:
+        serving.close()
+
+
+def test_als_hyperparam_tuning_picks_best(tmp_path):
+    """Real grid search through ALSUpdate: two candidate feature counts,
+    best held-out eval wins and its PMML records the winning value
+    (reference: ALSHyperParamTuningIT.java:36)."""
+    cfg = from_dict({
+        "oryx.id": "alsht",
+        "oryx.input-topic.broker": "memory://alsht",
+        "oryx.input-topic.partitions": 1,
+        "oryx.input-topic.message.topic": "HtIn",
+        "oryx.update-topic.broker": "memory://alsht",
+        "oryx.update-topic.message.topic": "HtUp",
+        "oryx.batch.update-class": "oryx_tpu.app.als.update.ALSUpdate",
+        "oryx.batch.storage.data-dir": str(tmp_path / "data"),
+        "oryx.batch.storage.model-dir": str(tmp_path / "model"),
+        "oryx.als.iterations": 3,
+        "oryx.als.implicit": True,
+        "oryx.als.hyperparams.features": [2, 4],
+        "oryx.ml.eval.test-fraction": 0.25,
+        "oryx.ml.eval.candidates": 2,
+        "oryx.ml.eval.parallelism": 2,
+    })
+    broker = get_broker("alsht")
+    rng = np.random.default_rng(17)
+    t = 1_700_000_000_000
+    for u in range(24):
+        for i in range(16):
+            if rng.random() < 0.5:
+                broker.send("HtIn", None,
+                            f"u{u},i{i},{rng.exponential(1):.2f},{t}")
+                t += 1000
+    BatchLayer(cfg).run_one_generation()
+    msgs = list(broker.consume("HtUp", from_beginning=True, max_idle_sec=0.2))
+    assert msgs and msgs[0].key == KEY_MODEL
+    # the published PMML's features extension holds one of the candidates
+    import re
+    m = re.search(r'name="features"\s+value="(\d+)"', msgs[0].message)
+    assert m and int(m.group(1)) in (2, 4)

@@ -221,7 +221,8 @@ def test_classification_distribution(rdf_server):
 def test_feature_importance(rdf_server):
     layer, _ = rdf_server
     imps = json.loads(_get(layer, "/feature/importance").read())
-    assert len(imps) == 3
+    # predictor-indexed (reference: importances sized by numPredictors)
+    assert len(imps) == 2
     assert sum(imps) == pytest.approx(1.0)
     one = json.loads(_get(layer, "/feature/importance/0").read())
     assert one == pytest.approx(imps[0])
