@@ -135,8 +135,9 @@ class _SidePlan(NamedTuple):
     """
 
     n_rows: int
-    # per batch: (host row indices, device cols (B,P), vals (B,P), mask (B,P))
-    batches: list[tuple[np.ndarray, jax.Array, jax.Array, jax.Array]]
+    # per batch: (device row indices (B,), device cols (B,P),
+    #             vals (B,P), mask (B,P))
+    batches: list[tuple[jax.Array, jax.Array, jax.Array, jax.Array]]
 
 
 def _pack_side(rows: np.ndarray, cols: np.ndarray, vals: np.ndarray,
@@ -161,32 +162,53 @@ def _pack_side(rows: np.ndarray, cols: np.ndarray, vals: np.ndarray,
         bcols[dst] = s_cols[src]
         bvals[dst] = s_vals[src]
         bmask[dst] = 1.0
-        batches.append((batch_rows,
+        batches.append((jnp.asarray(batch_rows.astype(np.int32)),
                         jnp.asarray(bcols.reshape(bsz, p)),
                         jnp.asarray(bvals.reshape(bsz, p)),
                         jnp.asarray(bmask.reshape(bsz, p))))
     return _SidePlan(n_rows, batches)
 
 
+@partial(jax.jit, donate_argnums=(0,))
+def _scatter_rows(out, rows, x):
+    # donating `out` lets XLA scatter in place instead of copying the
+    # full factor matrix every batch
+    return out.at[rows].set(x)
+
+
 def _solve_side(opposite: jax.Array, plan: _SidePlan,
-                k: int, lam: float, alpha: float, implicit: bool) -> np.ndarray:
-    """One half-sweep: solve every row's factor given the opposite side."""
+                k: int, lam: float, alpha: float,
+                implicit: bool) -> jax.Array:
+    """One half-sweep: solve every row's factor given the opposite side.
+
+    Everything stays on device — batches async-dispatch back to back,
+    and the returned factor feeds the next half-sweep's gathers directly
+    (factors cross the PCIe/tunnel boundary only when the caller
+    materializes them).  A backstop window bounds how many (B, P, k)
+    gather buffers can be live at once without any device->host
+    transfer: block_until_ready on an old batch is a sync, not a copy.
+    The bound is slot-based and GENEROUS (~32 × slot-budget × k × 4B ≈
+    6.7 GB at k=100) because each sync costs a full host<->device round
+    trip and measurably serializes the dispatch pipeline (a window of 8
+    cost more wall-clock at ML20M scale than it saved in memory) — it
+    exists to stop a pathological many-hundred-batch side from pinning
+    unbounded HBM, not to engage at normal scales."""
     G = _gramian(opposite) if implicit else jnp.zeros((k, k), jnp.float32)
     lam32, alpha32 = jnp.float32(lam), jnp.float32(alpha)
-    out = np.zeros((plan.n_rows, k), dtype=np.float32)
-    # keep a small async-dispatch window: enough to overlap host copies
-    # with device compute, bounded so only a couple of (B, P, k) gather
-    # buffers are ever live on device at once
-    pending: list[tuple[np.ndarray, jax.Array]] = []
+    out = jnp.zeros((plan.n_rows, k), dtype=jnp.float32)
+    pending: list[tuple[int, jax.Array]] = []
+    pending_slots = 0
     for batch_rows, bcols, bvals, bmask in plan.batches:
         Yg = opposite[bcols]
         x = _solve_batch(Yg, bvals, bmask, G, lam32, alpha32, implicit)
-        pending.append((batch_rows, x))
-        if len(pending) > 2:
-            rows, xd = pending.pop(0)
-            out[rows] = np.asarray(xd)
-    for rows, xd in pending:
-        out[rows] = np.asarray(xd)
+        out = _scatter_rows(out, batch_rows, x)
+        slots = int(bcols.shape[0] * bcols.shape[1])
+        pending.append((slots, x))
+        pending_slots += slots
+        while pending_slots > 32 * _BATCH_SLOT_BUDGET:
+            done_slots, done_x = pending.pop(0)
+            done_x.block_until_ready()
+            pending_slots -= done_slots
     return out
 
 
@@ -219,17 +241,20 @@ def train_als(ratings: ParsedRatings,
     rng = np.random.default_rng(
         RandomManager.random_seed() if seed is None else seed)
     # small random init, scaled like MLlib's (normalized gaussian / sqrt(k))
-    Y = (rng.standard_normal((n_items, k)) / math.sqrt(k)).astype(np.float32)
-    X = np.zeros((n_users, k), dtype=np.float32)
+    Y = jnp.asarray(
+        (rng.standard_normal((n_items, k)) / math.sqrt(k)).astype(np.float32))
+    X = jnp.zeros((n_users, k), dtype=jnp.float32)
 
     for it in range(iterations):
-        X = _solve_side(jnp.asarray(Y), user_plan, k, lam, alpha, implicit)
-        Y = _solve_side(jnp.asarray(X), item_plan, k, lam, alpha, implicit)
+        # factors never leave the device between half-sweeps
+        X = _solve_side(Y, user_plan, k, lam, alpha, implicit)
+        Y = _solve_side(X, item_plan, k, lam, alpha, implicit)
         _log.info("ALS iteration %d/%d done", it + 1, iterations)
         if on_iteration is not None:
-            on_iteration(it, X, Y)
+            on_iteration(it, np.asarray(X), np.asarray(Y))
 
-    return ALSModel(ratings.user_ids, ratings.item_ids, X, Y)
+    return ALSModel(ratings.user_ids, ratings.item_ids,
+                    np.asarray(X), np.asarray(Y))
 
 
 @jax.jit

@@ -19,7 +19,7 @@ from xml.etree.ElementTree import Element
 
 from ..common import pmml as pmml_io
 from ..common.config import Config
-from ..common.io_utils import delete_recursively, mkdirs, strip_scheme
+from ..common.io_utils import delete_recursively, mkdirs
 from ..common.lang import collect_in_parallel
 from ..common.rand import RandomManager
 from ..kafka.api import KEY_MODEL, KEY_MODEL_REF, KeyMessage, TopicProducer
@@ -44,6 +44,10 @@ class MLUpdate(BatchLayerUpdate, abc.ABC):
         self.eval_parallelism = config.get_int("oryx.ml.eval.parallelism")
         self.threshold = config.get_optional_double("oryx.ml.eval.threshold")
         self.max_message_size = config.get_int("oryx.update-topic.message.max-size")
+        # optional per-generation device trace (SURVEY §5.1: the TPU
+        # answer to the reference's per-layer Spark UI is a JAX profiler
+        # trace viewable in TensorBoard/Perfetto)
+        self.profile_dir = config.get_optional_string("oryx.ml.profile-dir")
         if not 0.0 <= self.test_fraction <= 1.0:
             raise ValueError("test-fraction must be in [0,1]")
         if self.candidates < 1:
@@ -112,8 +116,16 @@ class MLUpdate(BatchLayerUpdate, abc.ABC):
                                        str(int(time.time() * 1000)))
         mkdirs(candidates_path)
 
-        best_candidate = self._find_best_candidate_path(
-            new_data, past_data, combos, candidates_path)
+        import contextlib
+        if self.profile_dir:
+            import jax
+            trace = jax.profiler.trace(
+                mkdirs(os.path.join(self.profile_dir, str(timestamp_ms))))
+        else:
+            trace = contextlib.nullcontext()
+        with trace:
+            best_candidate = self._find_best_candidate_path(
+                new_data, past_data, combos, candidates_path)
 
         final_path = os.path.join(model_dir_local, str(int(time.time() * 1000)))
         if best_candidate is None:

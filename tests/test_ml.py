@@ -197,3 +197,21 @@ def test_mlupdate_model_ref_when_too_large(tmp_path):
     assert len(msgs) == 1
     assert msgs[0].key == "MODEL-REF"
     assert os.path.exists(msgs[0].message)
+
+
+def test_mlupdate_profile_dir_writes_trace(tmp_path):
+    """oryx.ml.profile-dir wraps candidate building in a JAX profiler
+    trace (SURVEY §5.1 observability: the Spark-UI equivalent)."""
+    import os
+    _reset_mock([0.5])
+    cfg = from_dict({"oryx.ml.profile-dir": str(tmp_path / "traces")})
+    update = MockMLUpdate(cfg)
+    data = [KeyMessage(None, f"line{i}") for i in range(20)]
+    update.run_update(1234, data, [], str(tmp_path / "model"), None)
+    # one timestamped trace dir with profiler output inside
+    roots = os.listdir(tmp_path / "traces")
+    assert roots == ["1234"]
+    found = []
+    for dirpath, _, files in os.walk(tmp_path / "traces"):
+        found.extend(files)
+    assert found, "profiler wrote no trace files"
