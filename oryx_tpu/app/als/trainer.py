@@ -249,6 +249,10 @@ def train_als(ratings: ParsedRatings,
         # factors never leave the device between half-sweeps
         X = _solve_side(Y, user_plan, k, lam, alpha, implicit)
         Y = _solve_side(X, item_plan, k, lam, alpha, implicit)
+        if _log.isEnabledFor(logging.INFO):
+            # sync (not copy) so the progress log reflects work actually
+            # done — everything dispatches asynchronously otherwise
+            Y.block_until_ready()
         _log.info("ALS iteration %d/%d done", it + 1, iterations)
         if on_iteration is not None:
             on_iteration(it, np.asarray(X), np.asarray(Y))

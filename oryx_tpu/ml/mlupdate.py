@@ -11,6 +11,7 @@ case, threshold gate), buildAndEval :299, splitTrainTest :346.
 from __future__ import annotations
 
 import abc
+import contextlib
 import logging
 import os
 import time
@@ -116,7 +117,6 @@ class MLUpdate(BatchLayerUpdate, abc.ABC):
                                        str(int(time.time() * 1000)))
         mkdirs(candidates_path)
 
-        import contextlib
         if self.profile_dir:
             import jax
             trace = jax.profiler.trace(
