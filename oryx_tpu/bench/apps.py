@@ -26,8 +26,10 @@ def bench_kmeans(n_points: int = 5_000_000, dims: int = 20, k: int = 100,
     rng = np.random.default_rng(seed)
     true_centers = rng.standard_normal((k, dims)).astype(np.float32) * 10
     assign = rng.integers(0, k, n_points)
+    # float32 generation directly — a float64 intermediate would double
+    # memory and generation time at bench scale
     pts = (true_centers[assign]
-           + rng.standard_normal((n_points, dims)).astype(np.float32))
+           + rng.standard_normal((n_points, dims), dtype=np.float32))
 
     # warm compile with the SAME shapes and static iteration count the
     # timed run uses — jit keys on both, so a smaller warm-up would
@@ -72,13 +74,15 @@ def bench_rdf(n_examples: int = 1_000_000, n_predictors: int = 20,
                           seed=seed, num_classes=2)
     total = time.perf_counter() - t0
 
-    # in-sample accuracy via the array-form batched forest
+    # in-sample accuracy via the array-form batched forest, on a sample
+    # (sample FIRST — materializing the full all-features matrix would
+    # do 20x the work for rows never predicted)
     from ..app.rdf.forest_arrays import ForestArrays
-    full = np.full((n_examples, schema.num_features), np.nan, np.float32)
-    full[:, :n_predictors] = x
-    arrays = ForestArrays(forest, schema.num_features, 2)
     sample = rng.choice(n_examples, min(n_examples, 50_000), replace=False)
-    probs = arrays.predict_proba(full[sample])
+    full = np.full((len(sample), schema.num_features), np.nan, np.float32)
+    full[:, :n_predictors] = x[sample]
+    arrays = ForestArrays(forest, schema.num_features, 2)
+    probs = arrays.predict_proba(full)
     acc = float((np.argmax(probs, axis=1) == y[sample]).mean())
     return {
         "metric": "rdf_train",

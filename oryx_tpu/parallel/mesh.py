@@ -14,7 +14,46 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-__all__ = ["build_mesh", "local_mesh"]
+__all__ = ["build_mesh", "local_mesh", "initialize_multihost"]
+
+
+def initialize_multihost(config=None) -> bool:
+    """Join this process to a multi-host JAX cluster, if configured.
+
+    The reference scales across machines with Spark executors over YARN
+    plus NCCL-free shuffle; the TPU-native equivalent is
+    ``jax.distributed`` — after initialization ``jax.devices()`` spans
+    every host's chips (ICI within a slice, DCN across slices), and the
+    SAME 1-D mesh + shard_map training code runs unchanged at multi-host
+    scale because it only ever names mesh axes, never hosts.
+
+    Config keys (all optional — on Cloud TPU the runtime supplies them
+    and a bare ``jax.distributed.initialize()`` suffices):
+      oryx.distributed.coordinator-address   host:port of process 0
+      oryx.distributed.num-processes
+      oryx.distributed.process-id
+
+    Returns True when distributed mode was initialized.  Safe to call
+    when unconfigured (no-op) or already initialized.
+    """
+    coord = num = pid = None
+    if config is not None:
+        coord = config.get_optional_string(
+            "oryx.distributed.coordinator-address")
+        if config.has_path("oryx.distributed.num-processes"):
+            num = config.get_int("oryx.distributed.num-processes")
+        if config.has_path("oryx.distributed.process-id"):
+            pid = config.get_int("oryx.distributed.process-id")
+    if coord is None and num is None and pid is None:
+        return False
+    if getattr(jax.distributed.global_state, "client", None) is not None:
+        return True  # already joined — idempotent
+    # a genuine join failure (unreachable coordinator, bad params) must
+    # propagate: silently training single-host when multi-host was
+    # configured would be the worst failure mode
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=num, process_id=pid)
+    return True
 
 
 def build_mesh(n_devices: int | None = None, axis: str = "d") -> Mesh:
@@ -47,11 +86,20 @@ def mesh_from_config(config, axis: str = "d") -> Mesh | None:
     master = config.get_string("oryx.batch.streaming.master")
     if master == "cpu":
         return None
+    # multi-host: join the cluster BEFORE the first jax.devices() call
+    # so the mesh spans every host's chips
+    initialize_multihost(config)
     if jax.default_backend() == "cpu" and master != "mesh":
         # "auto" on a CPU backend: virtual host devices exist only for
         # sharding tests; single-device XLA is faster for real work.
         # master = "mesh" forces a mesh over them (tests, dry runs).
         return None
+    if jax.process_count() > 1:
+        # multi-host: every process's local devices MUST be in the mesh
+        # (a truncated mesh would exclude some hosts' chips and deadlock
+        # their shard_map dispatches at the first collective), so the
+        # executor sizing is advisory only here
+        return build_mesh(None, axis)
     requested = (config.get_int("oryx.batch.streaming.num-executors")
                  * config.get_int("oryx.batch.streaming.executor-cores"))
     n = min(requested, len(jax.devices()))

@@ -171,3 +171,17 @@ def test_train_step_is_jittable_and_finite():
     X2, Y2 = step(X, Y, *args)
     assert np.isfinite(np.asarray(X2)).all()
     assert np.isfinite(np.asarray(Y2)).all()
+
+
+def test_initialize_multihost_noop_without_config():
+    """Unconfigured multi-host init is a no-op (single-host default);
+    the config keys exist in reference.conf as nulls."""
+    from oryx_tpu.common.config import from_dict, get_default
+    from oryx_tpu.parallel.mesh import initialize_multihost
+
+    assert initialize_multihost(None) is False
+    assert initialize_multihost(from_dict({})) is False
+    cfg = get_default()
+    assert cfg.get_optional_string(
+        "oryx.distributed.coordinator-address") is None
+    assert not cfg.has_path("oryx.distributed.num-processes")
