@@ -338,9 +338,17 @@ def train_forest(x: np.ndarray, y: np.ndarray, schema: InputSchema,
     is_cat_j = jnp.asarray(is_cat)
 
     for depth in range(max_depth + 1):
-        num_slots = max(len(ids) for ids in frontier_ids)
-        if num_slots == 0:
+        real_slots = max(len(ids) for ids in frontier_ids)
+        if real_slots == 0:
             break
+        # pad the frontier width to a power of two: levels then hit at
+        # most log2(max width) distinct kernel shapes, so the whole
+        # growth loop compiles once per width and every later
+        # generation (the batch layer retrains every interval) is pure
+        # cache hits.  Padding slots hold no samples — their histogram
+        # rows are zero and their (garbage) split decisions are never
+        # read on host.
+        num_slots = 1 << (real_slots - 1).bit_length()
         if mesh is not None:
             hist = _dist_histograms_fn(mesh, mesh_axis, num_slots,
                                        num_bins)(binned, ychan, w, slot_of)
