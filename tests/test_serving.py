@@ -478,3 +478,40 @@ def test_metrics_endpoint(server):
     assert rec["p50_ms"] > 0
     assert rec["p95_ms"] >= rec["p50_ms"]
     assert m["model_fraction_loaded"] == 1.0
+
+
+def test_https_silent_client_does_not_block_others(tmp_path):
+    """A client that connects to the TLS port and never speaks must not
+    stall the accept loop: the handshake is deferred to the connection's
+    worker thread, so other clients keep being served."""
+    import socket
+    import ssl
+    MockALSManager.model = _build_test_model()
+    pem = _self_signed_pem(tmp_path)
+    cfg = from_dict({
+        "oryx.serving.model-manager-class": "tests.test_serving.MockALSManager",
+        "oryx.serving.application-resources": "oryx_tpu.serving.als",
+        "oryx.serving.api.keystore-file": pem,
+        "oryx.input-topic.broker": "memory://serving-test-tls3",
+        "oryx.update-topic.broker": None,
+        "oryx.update-topic.message.topic": None,
+    })
+    layer = ServingLayer(cfg, port=0)
+    layer.start()
+    try:
+        # open raw TCP connections that never start a TLS handshake
+        silent = [socket.create_connection(("127.0.0.1", layer.port), 5)
+                  for _ in range(3)]
+        ctx = ssl.create_default_context()
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+        # real clients still get served promptly
+        for _ in range(3):
+            with urllib.request.urlopen(
+                    f"https://127.0.0.1:{layer.port}/ready",
+                    timeout=5, context=ctx) as r:
+                assert r.status in (200, 204)
+        for s in silent:
+            s.close()
+    finally:
+        layer.close()
