@@ -169,3 +169,120 @@ def test_als_hyperparam_tuning_picks_best(tmp_path):
     import re
     m = re.search(r'name="features"\s+value="(\d+)"', msgs[0].message)
     assert m and int(m.group(1)) in (2, 4)
+
+
+def _await_speed_model(speed, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        m = speed.model_manager.model
+        if m is not None:
+            return m
+        time.sleep(0.05)
+    raise AssertionError("speed model never loaded")
+
+
+def test_kmeans_speed_full_loop(tmp_path):
+    """SpeedLayer consumes the published k-means MODEL, then turns new
+    input into center-update UP deltas (reference: KMeansSpeedIT)."""
+    from oryx_tpu.lambda_rt.speed import SpeedLayer
+
+    cfg = from_dict({
+        "oryx.id": "kmsp",
+        "oryx.input-topic.broker": "memory://kmsp",
+        "oryx.input-topic.partitions": 1,
+        "oryx.input-topic.message.topic": "KmIn",
+        "oryx.update-topic.broker": "memory://kmsp",
+        "oryx.update-topic.message.topic": "KmUp",
+        "oryx.batch.update-class": "oryx_tpu.app.kmeans.update.KMeansUpdate",
+        "oryx.batch.storage.data-dir": str(tmp_path / "data"),
+        "oryx.batch.storage.model-dir": str(tmp_path / "model"),
+        "oryx.speed.model-manager-class":
+            "oryx_tpu.app.kmeans.speed.KMeansSpeedModelManager",
+        "oryx.kmeans.hyperparams.k": 2,
+        "oryx.input-schema.num-features": 2,
+        "oryx.input-schema.numeric-features": ["0", "1"],
+        "oryx.ml.eval.test-fraction": 0.2,
+    })
+    broker = get_broker("kmsp")
+    rng = np.random.default_rng(21)
+    for i in range(200):
+        c = (0.0, 0.0) if i % 2 else (9.0, 9.0)
+        broker.send("KmIn", None,
+                    f"{c[0] + rng.standard_normal() * 0.3:.3f},"
+                    f"{c[1] + rng.standard_normal() * 0.3:.3f}")
+    BatchLayer(cfg).run_one_generation()
+
+    speed = SpeedLayer(cfg)
+    speed.start()
+    try:
+        _await_speed_model(speed)
+        before = broker.latest_offset("KmUp")
+        for _ in range(10):
+            broker.send("KmIn", None, "8.9,9.1")
+        speed.run_one_micro_batch()
+        end = broker.latest_offset("KmUp")
+        ups = [json.loads(km.message)
+               for km in broker.read_range("KmUp", before, end)
+               if km.key == "UP"]
+        assert ups, "no k-means UP deltas"
+        # [clusterId, center, count]: the cluster absorbing the fed
+        # points grew and its center stays near them
+        grown = [u for u in ups if u[2] >= 10
+                 and abs(u[1][0] - 9.0) < 1.5 and abs(u[1][1] - 9.0) < 1.5]
+        assert grown, ups
+    finally:
+        speed.close()
+
+
+def test_rdf_speed_full_loop(tmp_path):
+    """SpeedLayer consumes the published forest MODEL, then routes new
+    labeled examples to terminal nodes and emits leaf-update deltas
+    (reference: RDFSpeedIT)."""
+    from oryx_tpu.lambda_rt.speed import SpeedLayer
+
+    cfg = from_dict({
+        "oryx.id": "rdfsp",
+        "oryx.input-topic.broker": "memory://rdfsp",
+        "oryx.input-topic.partitions": 1,
+        "oryx.input-topic.message.topic": "RdfIn",
+        "oryx.update-topic.broker": "memory://rdfsp",
+        "oryx.update-topic.message.topic": "RdfUp",
+        "oryx.batch.update-class": "oryx_tpu.app.rdf.update.RDFUpdate",
+        "oryx.batch.storage.data-dir": str(tmp_path / "data"),
+        "oryx.batch.storage.model-dir": str(tmp_path / "model"),
+        "oryx.speed.model-manager-class":
+            "oryx_tpu.app.rdf.speed.RDFSpeedModelManager",
+        "oryx.rdf.num-trees": 3,
+        "oryx.input-schema.feature-names": ["a", "b", "label"],
+        "oryx.input-schema.numeric-features": ["a", "b"],
+        "oryx.input-schema.target-feature": "label",
+        "oryx.ml.eval.test-fraction": 0.2,
+    })
+    broker = get_broker("rdfsp")
+    rng = np.random.default_rng(22)
+    for _ in range(300):
+        a, b = rng.uniform(-1, 1, 2)
+        label = "pos" if a > 0 else "neg"
+        broker.send("RdfIn", None, f"{a:.3f},{b:.3f},{label}")
+    BatchLayer(cfg).run_one_generation()
+
+    speed = SpeedLayer(cfg)
+    speed.start()
+    try:
+        _await_speed_model(speed)
+        before = broker.latest_offset("RdfUp")
+        for _ in range(8):
+            broker.send("RdfIn", None, "0.9,0.0,pos")
+        speed.run_one_micro_batch()
+        end = broker.latest_offset("RdfUp")
+        ups = [json.loads(km.message)
+               for km in broker.read_range("RdfUp", before, end)
+               if km.key == "UP"]
+        assert ups, "no RDF UP deltas"
+        # [treeID, nodeID, counts] — per-tree terminal updates
+        # (reference wire format: RDFSpeedModelManager joinJSON :127)
+        assert all(isinstance(u[0], int) and isinstance(u[1], str)
+                   and isinstance(u[2], dict) for u in ups)
+        assert {u[0] for u in ups} <= {0, 1, 2}  # three trees
+    finally:
+        speed.close()
