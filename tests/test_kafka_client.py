@@ -1,0 +1,195 @@
+"""Unit tests for the real-Kafka binding's client logic, with a stub
+``kafka`` package injected so no broker (or kafka-python) is needed.
+
+The live-broker behavior is covered by the contract suite in
+test_kafka.py (skipped when unreachable); these pin the pure logic —
+keyed commit-per-record, position-based gap-safe drains, consumer
+caching — that would otherwise only run in production.
+"""
+
+import sys
+import types
+
+import pytest
+
+
+class _FakeRecord:
+    def __init__(self, topic, partition, offset, key, value):
+        self.topic = topic
+        self.partition = partition
+        self.offset = offset
+        self.key = key
+        self.value = value
+
+
+class _FakeLog:
+    """Shared per-test broker state: topic -> partition -> records
+    (offsets may have gaps, like a compacted topic)."""
+
+    def __init__(self):
+        self.topics: dict[str, dict[int, list[_FakeRecord]]] = {}
+        self.committed: dict[tuple[str, str, int], int] = {}
+        self.consumers_created = 0
+
+    def add(self, topic, partition, offset, key, value):
+        self.topics.setdefault(topic, {}).setdefault(partition, []).append(
+            _FakeRecord(topic, partition, offset,
+                        key.encode() if key else None, value.encode()))
+
+
+class _FakeConsumer:
+    def __init__(self, log: _FakeLog, group):
+        self._log = log
+        self._group = group
+        self._assigned: list = []
+        self._pos: dict = {}
+        log.consumers_created += 1
+
+    # metadata
+    def partitions_for_topic(self, topic):
+        parts = self._log.topics.get(topic)
+        return set(parts) if parts else None
+
+    def end_offsets(self, tps):
+        out = {}
+        for tp in tps:
+            recs = self._log.topics.get(tp.topic, {}).get(tp.partition, [])
+            out[tp] = (recs[-1].offset + 1) if recs else 0
+        return out
+
+    # assignment / seeking
+    def assign(self, tps):
+        self._assigned = list(tps)
+
+    def unsubscribe(self):
+        self._assigned = []
+
+    def subscribe(self, topics):
+        self._assigned = []
+        for t in topics:
+            for p in sorted(self._log.topics.get(t, {0: []})):
+                self._assigned.append(_tp(t, p))
+
+    def seek(self, tp, offset):
+        self._pos[tp] = offset
+
+    def position(self, tp):
+        return self._pos.get(tp, 0)
+
+    def poll(self, timeout_ms=0):
+        out = {}
+        for tp in self._assigned:
+            recs = [r for r in self._log.topics
+                    .get(tp.topic, {}).get(tp.partition, [])
+                    if r.offset >= self._pos.get(tp, 0)]
+            if recs:
+                out[tp] = recs
+                self._pos[tp] = recs[-1].offset + 1
+        return out
+
+    # offsets
+    def committed(self, tp):
+        return self._log.committed.get((self._group, tp.topic, tp.partition))
+
+    def commit(self, offsets):
+        for tp, om in offsets.items():
+            self._log.committed[(self._group, tp.topic, tp.partition)] = \
+                om.offset
+
+    def close(self):
+        pass
+
+
+def _tp(topic, partition):
+    mod = sys.modules["kafka"]
+    return mod.TopicPartition(topic, partition)
+
+
+@pytest.fixture
+def fake_kafka(monkeypatch):
+    """Install a stub kafka package and return its shared log."""
+    log = _FakeLog()
+
+    import collections
+    TopicPartition = collections.namedtuple("TopicPartition",
+                                            ["topic", "partition"])
+    OffsetAndMetadata = collections.namedtuple("OffsetAndMetadata",
+                                               ["offset", "metadata"])
+
+    kafka_mod = types.ModuleType("kafka")
+    kafka_mod.TopicPartition = TopicPartition
+    kafka_mod.KafkaConsumer = lambda bootstrap_servers=None, group_id=None, \
+        enable_auto_commit=None, **kw: _FakeConsumer(log, group_id)
+    structs_mod = types.ModuleType("kafka.structs")
+    structs_mod.OffsetAndMetadata = OffsetAndMetadata
+    kafka_mod.structs = structs_mod
+    monkeypatch.setitem(sys.modules, "kafka", kafka_mod)
+    monkeypatch.setitem(sys.modules, "kafka.structs", structs_mod)
+
+    # fresh broker object per test (module-level registry is keyed)
+    from oryx_tpu.kafka.client import KafkaBroker
+    return KafkaBroker("fake:9092"), log
+
+
+def test_latest_and_num_partitions(fake_kafka):
+    broker, log = fake_kafka
+    log.add("t", 0, 0, None, "a")
+    log.add("t", 0, 1, None, "b")
+    log.add("t", 1, 0, None, "c")
+    assert broker.num_partitions("t") == 2
+    assert broker.latest_offsets("t") == [2, 1]
+
+
+def test_read_ranges_tolerates_offset_gaps(fake_kafka):
+    """Completion is judged by consumer POSITION: a range whose tail
+    offsets are compacted away must still drain without timing out."""
+    broker, log = fake_kafka
+    # offsets 0, 2, 4 exist; 1, 3 compacted away
+    for off in (0, 2, 4):
+        log.add("t", 0, off, "k", f"m{off}")
+    got = broker.read_ranges("t", [0], [5])
+    assert [km.message for km in got] == ["m0", "m2", "m4"]
+
+
+def test_offsets_roundtrip_and_fill_in_latest(fake_kafka):
+    broker, log = fake_kafka
+    log.add("t", 0, 0, None, "a")
+    log.add("t", 1, 0, None, "b")
+    log.add("t", 1, 1, None, "c")
+    assert broker.get_offsets("g", "t") == [None, None]
+    broker.set_offsets("g", "t", [1, 2])
+    assert broker.get_offsets("g", "t") == [1, 2]
+    broker.set_offset("g2", "t", 1, partition=1)
+    assert broker.get_offset("g2", "t", 1) == 1
+    broker.fill_in_latest_offsets("g3", ["t"])
+    assert broker.get_offsets("g3", "t") == [1, 2]
+
+
+def test_consume_commits_only_processed_record(fake_kafka):
+    """A poll batch of 3 with a consumer that stops after 1 must commit
+    only past the first record (at-least-once for the rest)."""
+    broker, log = fake_kafka
+    for off in range(3):
+        log.add("t", 0, off, None, f"m{off}")
+    it = broker.consume("t", group="g", from_beginning=True,
+                        max_idle_sec=0.2)
+    assert next(it).message == "m0"
+    # the commit for m0 lands when the consumer comes back for more —
+    # a crash mid-processing must leave the in-flight record uncommitted
+    assert ("g", "t", 0) not in log.committed
+    assert next(it).message == "m1"
+    it.close()
+    assert log.committed[("g", "t", 0)] == 1  # m1, m2 uncommitted
+
+
+def test_shared_consumer_is_cached(fake_kafka):
+    broker, log = fake_kafka
+    log.add("t", 0, 0, None, "a")
+    broker.latest_offsets("t")
+    broker.latest_offsets("t")
+    broker.num_partitions("t")
+    created_metadata = log.consumers_created
+    assert created_metadata == 1  # one shared group=None consumer
+    broker.get_offsets("g", "t")
+    broker.get_offsets("g", "t")
+    assert log.consumers_created == 2  # plus one for group g
