@@ -75,15 +75,25 @@ def _plan_batches(counts: np.ndarray) -> list[np.ndarray]:
 
     Rows are sorted by degree descending; each batch's padded width P is
     its max degree rounded to a power of two, and batch size B is capped
-    so B*P stays within the slot budget.  Returns arrays of row indices.
+    so B*P stays within the slot budget.  Every batch is emitted at
+    EXACTLY its width's full B — the tail of a degree class pads with
+    dummy row index len(counts) (scattered to a sacrificial extra row) —
+    so each P value compiles the solve kernel once; arbitrary tail sizes
+    would compile a fresh executable per tail.  Returns (row indices,
+    padded width P) pairs; the indices may contain the dummy index.
     """
+    n = len(counts)
     order = np.argsort(-counts, kind="stable")
     batches = []
-    i, n = 0, len(order)
+    i = 0
     while i < n:
         p = _next_pow2(max(1, int(counts[order[i]])))
         b = max(1, min(_MAX_B, _BATCH_SLOT_BUDGET // p))
-        batches.append(order[i:i + b])
+        batch = order[i:i + b]
+        if len(batch) < b:
+            batch = np.concatenate(
+                [batch, np.full(b - len(batch), n, dtype=batch.dtype)])
+        batches.append((batch, p))
         i += b
     return batches
 
@@ -143,18 +153,21 @@ class _SidePlan(NamedTuple):
 def _pack_side(rows: np.ndarray, cols: np.ndarray, vals: np.ndarray,
                n_rows: int) -> _SidePlan:
     """CSR-group by row, then pack into padded batches with vectorized
-    scatter (no per-row Python loop)."""
+    scatter (no per-row Python loop).  Dummy row indices (== n_rows,
+    from tail padding) carry zero interactions and scatter to the
+    sacrificial extra row of the output."""
     s_cols, s_vals, row_ptr, counts = _csr_by(rows, cols, vals, n_rows)
+    counts_ext = np.concatenate([counts, [0]])     # dummy row: degree 0
+    row_ptr_ext = np.concatenate([row_ptr, [row_ptr[-1]]])
     batches = []
-    for batch_rows in _plan_batches(counts):
+    for batch_rows, p in _plan_batches(counts):
         bsz = len(batch_rows)
-        p = _next_pow2(max(1, int(counts[batch_rows[0]])))
-        c = counts[batch_rows].astype(np.int64)
+        c = counts_ext[batch_rows].astype(np.int64)
         total = int(c.sum())
         # flat source/destination index vectors for all real slots at once
         within = np.arange(total, dtype=np.int64) - np.repeat(
             np.cumsum(c) - c, c)
-        src = np.repeat(row_ptr[batch_rows], c) + within
+        src = np.repeat(row_ptr_ext[batch_rows], c) + within
         dst = np.repeat(np.arange(bsz, dtype=np.int64) * p, c) + within
         bcols = np.zeros(bsz * p, dtype=np.int32)
         bvals = np.zeros(bsz * p, dtype=np.float32)
@@ -195,7 +208,9 @@ def _solve_side(opposite: jax.Array, plan: _SidePlan,
     unbounded HBM, not to engage at normal scales."""
     G = _gramian(opposite) if implicit else jnp.zeros((k, k), jnp.float32)
     lam32, alpha32 = jnp.float32(lam), jnp.float32(alpha)
-    out = jnp.zeros((plan.n_rows, k), dtype=jnp.float32)
+    # one sacrificial extra row absorbs the scatters of dummy (tail
+    # padding) batch indices; sliced off on return
+    out = jnp.zeros((plan.n_rows + 1, k), dtype=jnp.float32)
     pending: list[tuple[int, jax.Array]] = []
     pending_slots = 0
     for batch_rows, bcols, bvals, bmask in plan.batches:
@@ -209,7 +224,7 @@ def _solve_side(opposite: jax.Array, plan: _SidePlan,
             done_slots, done_x = pending.pop(0)
             done_x.block_until_ready()
             pending_slots -= done_slots
-    return out
+    return out[:plan.n_rows]
 
 
 def train_als(ratings: ParsedRatings,
