@@ -56,3 +56,16 @@ def test_traffic_generator(load_server):
     assert stats.requests + stats.errors > 0
     # estimates for random ids can 404/503-free: all mix endpoints exist
     assert stats.errors == 0
+
+
+def test_bench_apps_small_scale():
+    """The kmeans/RDF bench harness runs end to end at toy scale (the
+    recorded artifacts use the same code at full scale on the chip)."""
+    from oryx_tpu.bench.apps import bench_kmeans, bench_rdf
+
+    km = bench_kmeans(n_points=2000, dims=4, k=3, iterations=2)
+    assert km["iteration_s"] > 0 and km["points"] == 2000
+    rdf = bench_rdf(n_examples=1500, n_predictors=4, num_trees=2,
+                    max_depth=3)
+    assert rdf["warm_total_s"] > 0
+    assert 0.5 < rdf["train_accuracy"] <= 1.0
