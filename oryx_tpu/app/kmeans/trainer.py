@@ -82,6 +82,22 @@ def _kmeans_pp_weighted(cands: np.ndarray, weights: np.ndarray, k: int,
     return np.stack(centers).astype(np.float32)
 
 
+def _assign_padded(points: np.ndarray,
+                   cands: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """assign_points with the candidate set padded to a power of two:
+    the candidate count changes every k-means|| round, and each distinct
+    shape would otherwise compile a fresh assignment kernel.  Padding
+    rows DUPLICATE the first candidate — argmin ties resolve to the
+    lowest index, so a padding row can never be selected and no sentinel
+    magnitude can overflow the float32 distance kernel."""
+    m = len(cands)
+    pad = (1 << max(0, (m - 1).bit_length())) - m
+    if pad:
+        cands = np.concatenate(
+            [cands, np.broadcast_to(cands[0], (pad, cands.shape[1]))])
+    return assign_points(points, cands)
+
+
 def _init_parallel(points: np.ndarray, k: int,
                    rng: np.random.Generator) -> np.ndarray:
     """k-means|| (Bahmani et al.): oversample ~2k candidates per round
@@ -90,7 +106,7 @@ def _init_parallel(points: np.ndarray, k: int,
     n = len(points)
     first = points[rng.integers(n)][None, :]
     cands = first
-    _, dist = assign_points(points, cands)
+    _, dist = _assign_padded(points, cands)
     d2 = dist.astype(np.float64) ** 2
     ell = 2.0 * k
     for _ in range(_INIT_ROUNDS):
@@ -102,14 +118,14 @@ def _init_parallel(points: np.ndarray, k: int,
         if len(chosen) == 0:
             continue
         cands = np.concatenate([cands, chosen])
-        _, dist = assign_points(points, cands)
+        _, dist = _assign_padded(points, cands)
         d2 = dist.astype(np.float64) ** 2
     if len(cands) <= k:
         # not enough candidates; fill with random points
         extra = points[rng.choice(n, size=k - len(cands) + 1, replace=n < k)]
         cands = np.concatenate([cands, extra])
     # weight candidates by how many points they attract
-    idx, _ = assign_points(points, cands)
+    idx, _ = _assign_padded(points, cands)
     weights = np.bincount(idx, minlength=len(cands)).astype(np.float64)
     weights = np.maximum(weights, 1e-12)
     return _kmeans_pp_weighted(cands.astype(np.float64), weights, k, rng)

@@ -290,3 +290,16 @@ def test_add_endpoint_writes_input(kmeans_server):
     before = broker.latest_offset("KInput")
     _get(layer, "/add/1.0,2.0")
     assert broker.latest_offset("KInput") == before + 1
+
+
+def test_kmeans_parallel_init_large_magnitude_features():
+    """k-means|| init must survive un-normalized data (e.g. an
+    epoch-timestamp-scale feature): the padded assignment kernel
+    duplicates a real candidate instead of using a sentinel whose dot
+    products would overflow float32."""
+    rng = np.random.default_rng(1)
+    pts = rng.standard_normal((500, 3)).astype(np.float32)
+    pts[:, 0] += 1.7e9
+    clusters = train_kmeans(pts, k=3, iterations=3, seed=4)
+    assert len(clusters) == 3
+    assert all(np.isfinite(c.center).all() for c in clusters)
