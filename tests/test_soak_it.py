@@ -1,0 +1,133 @@
+"""Concurrent three-layer soak: batch, speed and serving live at once
+over one broker while traffic flows and the model hot-swaps.
+
+The sequential ITs (test_lambda_it.py, test_lambda_apps_it.py) exercise
+each layer's correctness in isolation; this one exercises what only
+concurrency can — the serving model's read/write locking under load,
+MODEL replay racing UP deltas, and the retain-on-swap grace logic —
+the behaviors reference §5.2 guards with AutoReadWriteLock and
+versioned snapshots.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+from oryx_tpu.common.config import from_dict
+from oryx_tpu.kafka.inproc import get_broker
+from oryx_tpu.lambda_rt.batch import BatchLayer
+from oryx_tpu.lambda_rt.serving import ServingLayer
+from oryx_tpu.lambda_rt.speed import SpeedLayer
+
+
+def test_three_layers_concurrent_soak(tmp_path):
+    cfg = from_dict({
+        "oryx.id": "soak",
+        "oryx.input-topic.broker": "memory://soak",
+        "oryx.input-topic.partitions": 1,
+        "oryx.input-topic.message.topic": "SoakIn",
+        "oryx.update-topic.broker": "memory://soak",
+        "oryx.update-topic.message.topic": "SoakUp",
+        "oryx.batch.update-class": "oryx_tpu.app.als.update.ALSUpdate",
+        "oryx.speed.model-manager-class":
+            "oryx_tpu.app.als.speed.ALSSpeedModelManager",
+        "oryx.serving.model-manager-class":
+            "oryx_tpu.app.als.serving_manager.ALSServingModelManager",
+        "oryx.serving.application-resources": "oryx_tpu.serving.als",
+        "oryx.batch.storage.data-dir": str(tmp_path / "data"),
+        "oryx.batch.storage.model-dir": str(tmp_path / "model"),
+        "oryx.als.iterations": 2,
+        "oryx.als.implicit": True,
+        "oryx.als.hyperparams.features": 3,
+        "oryx.ml.eval.test-fraction": 0.0,
+    })
+    broker = get_broker("soak")
+    rng = np.random.default_rng(31)
+    ts = 1_700_000_000_000
+    for u in range(20):
+        for i in range(12):
+            if rng.random() < 0.5:
+                broker.send("SoakIn", None,
+                            f"u{u},i{i},{rng.exponential(1):.2f},{ts}")
+                ts += 1000
+
+    batch = BatchLayer(cfg)
+    batch.run_one_generation()  # first model exists before layers start
+
+    speed = SpeedLayer(cfg)
+    serving = ServingLayer(cfg, port=0)
+    speed.start()
+    serving.start()
+    errors: list[str] = []
+    stop = threading.Event()
+    try:
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            m = serving.model_manager.get_model()
+            if m is not None and m.get_fraction_loaded() >= 0.8:
+                break
+            time.sleep(0.05)
+        base = f"http://127.0.0.1:{serving.port}"
+
+        def reader(worker: int):
+            rng_l = np.random.default_rng(worker)
+            while not stop.is_set():
+                uid = f"u{rng_l.integers(0, 20)}"
+                try:
+                    with urllib.request.urlopen(
+                            f"{base}/recommend/{uid}?howMany=3",
+                            timeout=10) as r:
+                        json.loads(r.read())
+                except urllib.error.HTTPError as e:
+                    if e.code not in (404, 503):  # new users may 404
+                        errors.append(f"recommend {uid}: HTTP {e.code}")
+                except Exception as e:  # noqa: BLE001
+                    errors.append(f"recommend {uid}: {e}")
+
+        def writer():
+            n = 0
+            while not stop.is_set():
+                try:
+                    req = urllib.request.Request(
+                        f"{base}/pref/u{n % 25}/i{n % 12}", method="POST",
+                        data=b"1.0")
+                    urllib.request.urlopen(req, timeout=10).read()
+                except Exception as e:  # noqa: BLE001
+                    errors.append(f"pref: {e}")
+                n += 1
+                time.sleep(0.01)
+
+        threads = [threading.Thread(target=reader, args=(w,), daemon=True)
+                   for w in range(4)] + [
+            threading.Thread(target=writer, daemon=True)]
+        for t in threads:
+            t.start()
+
+        # under live traffic: a speed micro-batch emits UP deltas and a
+        # fresh batch generation hot-swaps the MODEL
+        time.sleep(1.0)
+        speed.run_one_micro_batch()
+        batch.run_one_generation()
+        time.sleep(2.0)
+
+        stop.set()
+        for t in threads:
+            t.join(10.0)
+        assert not errors, errors[:5]
+
+        # the swapped model still serves, including the new user the
+        # writer introduced (u20+ arrived via /pref -> input topic ->
+        # second generation)
+        with urllib.request.urlopen(f"{base}/ready", timeout=10) as r:
+            assert r.status in (200, 204)
+        model = serving.model_manager.get_model()
+        assert model.get_fraction_loaded() >= 0.8
+        assert "u20" in model.all_user_ids()  # writer-introduced user
+    finally:
+        stop.set()
+        serving.close()
+        speed.close()
