@@ -180,8 +180,32 @@ class KafkaBroker:
     def read_ranges(self, topic: str, starts: list[int | None],
                     ends: list[int]) -> list[KeyMessage]:
         from kafka import TopicPartition
-        with self._shared_consumer(self, None) as c:
-            parts = sorted(c.partitions_for_topic(topic) or [0])
+        if len(starts) != len(ends):
+            raise ValueError(
+                f"read_ranges: {len(starts)} starts vs {len(ends)} ends")
+        if all(e <= (0 if s is None else s)
+               for s, e in zip(starts, ends)):
+            # idle tails poll every topic twice a second — don't pay a
+            # consumer bootstrap just to drain nothing
+            return []
+        # Dedicated consumer: a drain can poll up to 30 s per partition,
+        # which must not hold the shared-consumer cache lock and block
+        # every other metadata/offset call in the process.
+        c = self._consumer(group=None)
+        try:
+            parts_meta = c.partitions_for_topic(topic)
+            if parts_meta is None:
+                # zip() against a guessed [0] would silently truncate
+                # and let the caller commit ends for undrained
+                # partitions — records lost for good
+                raise ValueError(
+                    f"read_ranges: no partition metadata for {topic!r}")
+            parts = sorted(parts_meta)
+            if len(parts) != len(starts):
+                raise ValueError(
+                    f"read_ranges: topic {topic!r} has {len(parts)} "
+                    f"partition(s) but {len(starts)} range(s) were given"
+                    " — refusing a partial drain")
             out: list[KeyMessage] = []
             for p, (s, e) in zip(parts, zip(starts, ends)):
                 s = 0 if s is None else s
@@ -208,9 +232,9 @@ class KafkaBroker:
                             if r.offset >= e:
                                 break
                             out.append(KeyMessage(_dec(r.key), _dec(r.value)))
-            # leave the shared consumer unassigned for the next borrower
-            c.unsubscribe()
             return out
+        finally:
+            c.close()
 
     def consume(self, topic: str, group: str | None = None,
                 from_beginning: bool = False,
@@ -224,10 +248,25 @@ class KafkaBroker:
             auto_offset_reset="earliest" if from_beginning else "latest")
         c.subscribe([topic])
         idle_since = time.monotonic()
+        # Offsets of records already handed back AND processed (control
+        # returned to this generator, i.e. the caller asked for the next
+        # one).  Committed in one round trip per poll batch — one
+        # blocking commit per record would throttle the update-topic
+        # tail to the broker's commit RTT.  A crash between commits
+        # re-delivers processed-but-uncommitted records: at-least-once.
+        pending: dict = {}
+
+        def _commit_pending() -> None:
+            if group is not None and pending:
+                c.commit({tp: OffsetAndMetadata(off, None)
+                          for tp, off in pending.items()})
+                pending.clear()
+
         try:
             while True:
                 if stop is not None and stop.is_set():
                     return
+                _commit_pending()
                 polled = c.poll(timeout_ms=int(poll_timeout_sec * 1000))
                 got = False
                 for recs in polled.values():
@@ -235,20 +274,22 @@ class KafkaBroker:
                         got = True
                         idle_since = time.monotonic()
                         yield KeyMessage(_dec(r.key), _dec(r.value))
-                        if group is not None:
-                            # commit ONLY the record just processed —
-                            # a bare commit() would commit the whole
-                            # polled batch and lose unprocessed records
-                            # on a crash (at-least-once violation)
-                            c.commit({TopicPartition(r.topic, r.partition):
-                                      OffsetAndMetadata(r.offset + 1, None)})
+                        # reaching here means the caller consumed the
+                        # record; a bare commit() before the yield would
+                        # commit unprocessed records (at-least-once
+                        # violation)
+                        pending[TopicPartition(r.topic, r.partition)] = (
+                            r.offset + 1)
                         if stop is not None and stop.is_set():
                             return
                 if (not got and max_idle_sec is not None
                         and time.monotonic() - idle_since > max_idle_sec):
                     return
         finally:
-            c.close()
+            try:
+                _commit_pending()
+            finally:
+                c.close()
 
     # -- offsets (broker-side group offsets; KafkaUtils.java:134-180) --------
 

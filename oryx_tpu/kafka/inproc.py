@@ -274,7 +274,7 @@ class InProcBroker:
                 self._offsets = _decode_offsets(json.load(f))
         if self._persist_dir:
             metas: dict[str, int] = {}
-            legacy: set[str] = set()
+            flat: set[str] = set()
             for fn in os.listdir(self._persist_dir):
                 if fn.endswith(".meta.json"):
                     t = fn[:-len(".meta.json")]
@@ -282,13 +282,21 @@ class InProcBroker:
                               encoding="utf-8") as f:
                         metas[t] = int(json.load(f).get("partitions", 1))
                 elif fn.endswith(".topic.jsonl"):
-                    base = fn[:-len(".topic.jsonl")]
-                    # partition files look like "<topic>.p<i>"; flat files
-                    # are single-partition logs
-                    head, dot, tail = base.rpartition(".")
-                    if not (dot and tail.startswith("p")
-                            and tail[1:].isdigit()):
-                        legacy.add(base)
+                    flat.add(fn[:-len(".topic.jsonl")])
+            # Partition files look like "<topic>.p<i>" — but they are
+            # only ever written alongside a meta sidecar (create_topic
+            # writes meta iff partitions > 1), so the ".p<i>" suffix is
+            # a partition marker only when the stripped name has a meta.
+            # A topic legitimately named "events.p2" is a flat log of
+            # its own and must be restored as such.
+            legacy: set[str] = set()
+            for base in flat:
+                head, dot, tail = base.rpartition(".")
+                is_partition_file = (dot and tail.startswith("p")
+                                     and tail[1:].isdigit()
+                                     and head in metas)
+                if not is_partition_file:
+                    legacy.add(base)
             for t, n in metas.items():
                 self._topics[t] = _Topic(
                     t, _partition_paths(self._persist_dir, t, n))

@@ -359,3 +359,39 @@ def test_produce_data_and_consume_topic():
     fields = got[0].message.split(",")
     assert fields[0] == "0" and fields[1] in ("true", "false")
     float(fields[2])
+
+
+def test_restart_restores_topic_named_like_partition_file(tmp_path):
+    """A topic legitimately named '<x>.p<digits>' must survive a broker
+    restart as its own flat topic, not be misread as a partition file of
+    a topic '<x>' that does not exist (ADVICE r2, inproc restart scan)."""
+    b1 = InProcBroker("pn1", persist_dir=str(tmp_path))
+    b1.send("events.p2", "k", "v")
+    # a sibling flat topic with the stripped name must not change the
+    # classification of "events.p2" (it is NOT a partition of "events")
+    b1.send("events", "k", "w")
+    b1.flush()
+    b2 = InProcBroker("pn2", persist_dir=str(tmp_path))
+    assert b2.topic_exists("events.p2")
+    assert b2.topic_exists("events")
+    msgs = list(b2.consume("events.p2", from_beginning=True,
+                           max_idle_sec=0.1))
+    assert [(m.key, m.message) for m in msgs] == [("k", "v")]
+
+
+def test_restart_still_recognizes_real_partition_files(tmp_path):
+    """The partition-file heuristic keeps working when the base topic's
+    flat (partition-0) file and meta sidecar are present."""
+    b1 = InProcBroker("pr1", persist_dir=str(tmp_path))
+    b1.create_topic("multi", partitions=3)
+    for i in range(6):
+        b1.send("multi", f"k{i}", f"v{i}")
+    b1.flush()
+    b2 = InProcBroker("pr2", persist_dir=str(tmp_path))
+    assert b2.num_partitions("multi") == 3
+    # 'multi.p1'/'multi.p2' must NOT appear as standalone topics
+    assert not b2.topic_exists("multi.p1")
+    assert not b2.topic_exists("multi.p2")
+    got = sorted(m.message for m in b2.consume(
+        "multi", from_beginning=True, max_idle_sec=0.1))
+    assert got == [f"v{i}" for i in range(6)]

@@ -193,3 +193,56 @@ def test_shared_consumer_is_cached(fake_kafka):
     broker.get_offsets("g", "t")
     broker.get_offsets("g", "t")
     assert log.consumers_created == 2  # plus one for group g
+
+
+def test_read_ranges_validates_range_count(fake_kafka):
+    """ADVICE r2 (medium): zip() must not silently truncate — the batch
+    layer would commit ends for partitions that were never drained."""
+    broker, log = fake_kafka
+    log.add("t", 0, 0, None, "a")
+    log.add("t", 1, 0, None, "b")
+    with pytest.raises(ValueError):
+        broker.read_ranges("t", [0], [1])          # 2 partitions, 1 range
+    with pytest.raises(ValueError):
+        broker.read_ranges("t", [0, 0], [1])       # starts/ends mismatch
+    with pytest.raises(ValueError):
+        broker.read_ranges("missing", [0], [1])    # no partition metadata
+
+
+def test_read_ranges_uses_dedicated_consumer(fake_kafka):
+    """Range drains can block up to 30 s per partition; they must not
+    borrow (and hold the lock of) the shared metadata consumer."""
+    broker, log = fake_kafka
+    log.add("t", 0, 0, None, "a")
+    broker.latest_offsets("t")            # creates the shared consumer
+    base = log.consumers_created
+    broker.read_ranges("t", [0], [1])
+    broker.read_ranges("t", [0], [1])
+    assert log.consumers_created == base + 2  # one fresh consumer each
+
+
+def test_consume_commits_on_poll_batch_boundaries(fake_kafka):
+    """ADVICE r2: one synchronous commit per record throttles the
+    update-topic tail; commits must batch per poll while staying
+    at-least-once (only fully-processed records committed)."""
+    broker, log = fake_kafka
+    commits = []
+    orig_commit = _FakeConsumer.commit
+
+    def counting_commit(self, offsets):
+        commits.append({tp: om.offset for tp, om in offsets.items()})
+        orig_commit(self, offsets)
+
+    _FakeConsumer.commit = counting_commit
+    try:
+        for off in range(4):
+            log.add("t", 0, off, None, f"m{off}")
+        msgs = [km.message for km in broker.consume(
+            "t", group="g", from_beginning=True, max_idle_sec=0.2)]
+    finally:
+        _FakeConsumer.commit = orig_commit
+    assert msgs == ["m0", "m1", "m2", "m3"]
+    # all four drained in one poll -> at most a couple of batched
+    # commits (boundary + final), never one per record
+    assert len(commits) <= 2
+    assert log.committed[("g", "t", 0)] == 4
