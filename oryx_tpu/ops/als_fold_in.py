@@ -23,6 +23,13 @@ import numpy as np
 __all__ = ["compute_target_qui", "fold_in_batch", "fold_in_sequential"]
 
 
+def _pow2_bucket(n: int) -> int:
+    """Smallest power-of-two batch bucket >= n (floor 8): the fold-in
+    kernels are jitted on shape, so arbitrary live batch sizes must be
+    padded into a small set of compile-once buckets."""
+    return max(8, 1 << max(0, n - 1).bit_length())
+
+
 def compute_target_qui(implicit: bool, value, current_value):
     """Vectorized target-strength computation; NaN signals "no change"
     (exact semantics of ALSUtils.computeTargetQui)."""
@@ -68,16 +75,26 @@ def fold_in_batch(solver, values, xu, yi, implicit: bool):
       which events produced an update (False mirrors the reference
       returning null — missing Yi or target says "no change").
     """
-    values = jnp.asarray(values, dtype=jnp.float32)
-    xu = jnp.asarray(xu, dtype=jnp.float32)
-    yi = jnp.asarray(yi, dtype=jnp.float32)
-    has_xu = ~jnp.any(jnp.isnan(xu), axis=1)
-    has_yi = ~jnp.any(jnp.isnan(yi), axis=1)
-    xu = jnp.nan_to_num(xu)
-    yi = jnp.nan_to_num(yi)
+    values = np.asarray(values, dtype=np.float32)
+    xu = np.asarray(xu, dtype=np.float32)
+    yi = np.asarray(yi, dtype=np.float32)
+    n = len(values)
+    # Pad to a power-of-two bucket: under live traffic every micro-batch
+    # arrives with a different size, and an unpadded batch dim would
+    # compile a fresh kernel per distinct n.  Padded rows are all-NaN,
+    # which the has_xu/has_yi masks turn into no-ops.
+    m = _pow2_bucket(n)
+    if m != n:
+        values = np.pad(values, (0, m - n))
+        xu = np.pad(xu, ((0, m - n), (0, 0)), constant_values=np.nan)
+        yi = np.pad(yi, ((0, m - n), (0, 0)), constant_values=np.nan)
+    has_xu = ~np.any(np.isnan(xu), axis=1)
+    has_yi = ~np.any(np.isnan(yi), axis=1)
+    xu = np.nan_to_num(xu)
+    yi = np.nan_to_num(yi)
     new_xu, valid = _fold_in_kernel(solver.cholesky, values, xu, has_xu, yi,
                                     has_yi, implicit)
-    return np.asarray(new_xu), np.asarray(valid)
+    return np.asarray(new_xu)[:n], np.asarray(valid)[:n]
 
 
 @partial(jax.jit, static_argnames=("implicit",))
@@ -118,8 +135,7 @@ def fold_in_sequential(solver, item_values, get_item_vector,
     # pad the scan length to a power-of-two bucket so request-size
     # variation doesn't retrace the kernel; padded rows carry
     # has_yi=False and are no-ops
-    n = max(8, 1 << (len(item_values) - 1).bit_length()) \
-        if item_values else 8
+    n = _pow2_bucket(len(item_values))
     values = np.zeros(n, dtype=np.float32)
     yi = np.zeros((n, features), dtype=np.float32)
     has_yi = np.zeros(n, dtype=bool)
