@@ -89,11 +89,11 @@ class SolverCache:
 class FactorModelBase:
     """X/Y stores + expected-ID accounting + cached solvers."""
 
-    def __init__(self, features: int, implicit: bool):
+    def __init__(self, features: int, implicit: bool, dtype="float32"):
         self.features = features
         self.implicit = implicit
-        self.X = FeatureVectorStore(features)
-        self.Y = FeatureVectorStore(features)
+        self.X = FeatureVectorStore(features, dtype=dtype)
+        self.Y = FeatureVectorStore(features, dtype=dtype)
         self._expected_user_ids: set[str] = set()
         self._expected_item_ids: set[str] = set()
         self._expected_lock = threading.Lock()

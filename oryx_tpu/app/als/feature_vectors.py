@@ -27,23 +27,50 @@ import numpy as np
 
 from ...common.lang import AutoReadWriteLock
 
-__all__ = ["FeatureVectorStore"]
+__all__ = ["FeatureVectorStore", "resolve_dtype"]
 
 # above this fraction of dirty rows, re-upload the whole array instead of
 # scattering individual rows
 _FULL_UPLOAD_FRACTION = 0.5
 
+# beyond this many rows, capacity is rounded to a multiple of this chunk
+# instead of the next power of two: a 20M-item model must not allocate a
+# 32M-row device array, and the chunked top-N kernel requires the row
+# count to be a multiple of its scan chunk (serving_model._CHUNK_ROWS)
+_LARGE_ALIGN = 1 << 17
+
+
+def resolve_dtype(name) -> np.dtype:
+    """Factor storage dtype from a config string.  ``bfloat16`` halves
+    both host and HBM footprint (20M x 250 drops from 20 GB to 10 GB —
+    the reference's largest published model, docs/docs/performance.html
+    memory table) and the MXU natively multiplies bf16 with float32
+    accumulation, so dot-product scores keep full precision."""
+    if name is None or isinstance(name, np.dtype):
+        return np.dtype(np.float32) if name is None else name
+    name = str(name)
+    if name in ("bfloat16", "bf16"):
+        import ml_dtypes
+        return np.dtype(ml_dtypes.bfloat16)
+    if name in ("float32", "f32"):
+        return np.dtype(np.float32)
+    raise ValueError(f"unsupported factor dtype: {name}")
+
 
 class FeatureVectorStore:
     """Mutable {id -> float32[k]} map materialized as a device array."""
 
-    def __init__(self, features: int, initial_capacity: int = 1024):
+    def __init__(self, features: int, initial_capacity: int = 1024,
+                 dtype="float32"):
         self.features = features
+        self.dtype = resolve_dtype(dtype)
         cap = max(16, initial_capacity)
+        if cap > _LARGE_ALIGN:
+            cap = -(-cap // _LARGE_ALIGN) * _LARGE_ALIGN
         self._id_to_row: dict[str, int] = {}
         self._row_to_id: list[str | None] = [None] * cap
         self._free: list[int] = list(range(cap - 1, -1, -1))
-        self._host = np.zeros((cap, features), dtype=np.float32)
+        self._host = np.zeros((cap, features), dtype=self.dtype)
         self._active = np.zeros(cap, dtype=bool)
         self._dirty: set[int] = set()
         self._device: jax.Array | None = None
@@ -72,7 +99,8 @@ class FeatureVectorStore:
     def get_vector(self, id_: str) -> np.ndarray | None:
         with self._lock.read():
             row = self._id_to_row.get(id_)
-            return None if row is None else self._host[row].copy()
+            return None if row is None \
+                else self._host[row].astype(np.float32)
 
     def row_of(self, id_: str) -> int | None:
         with self._lock.read():
@@ -102,15 +130,20 @@ class FeatureVectorStore:
         consumption and benchmark model factories.  Equivalent to
         set_vector per row but one vectorized host write instead of n
         dict/array operations."""
-        matrix = np.asarray(matrix, dtype=np.float32)
+        matrix = np.asarray(matrix)
+        if matrix.dtype != self.dtype:
+            matrix = matrix.astype(self.dtype)
         if matrix.shape != (len(ids), self.features):
             raise ValueError(
                 f"matrix must be ({len(ids)}, {self.features}), "
                 f"got {matrix.shape}")
         with self._lock.write():
             new_ids = [i for i in ids if i not in self._id_to_row]
-            while len(self._free) < len(new_ids):
-                self._grow()
+            if len(self._free) < len(new_ids):
+                # size once, exactly: a 20M-row load must not hit
+                # pow2-doubling (a 33.5M-row array at 250 features is
+                # 13.4 GB of pure padding)
+                self._grow(len(self._id_to_row) + len(new_ids))
             rows = np.empty(len(ids), dtype=np.int64)
             for j, id_ in enumerate(ids):
                 row = self._id_to_row.get(id_)
@@ -155,16 +188,26 @@ class FeatureVectorStore:
                 self._free.append(row)
             self._recent.clear()
 
-    def _grow(self) -> None:
+    def _grow(self, min_capacity: int | None = None) -> None:
         old_cap = len(self._row_to_id)
-        new_cap = old_cap * 2
-        host = np.zeros((new_cap, self.features), dtype=np.float32)
+        if old_cap >= 4 * _LARGE_ALIGN:
+            # large stores grow by ~12.5% in chunk steps: doubling a
+            # 20M-row exact-fit array when streaming updates exhaust its
+            # head-room would allocate the very padding bulk_load avoids
+            new_cap = old_cap + max(_LARGE_ALIGN, old_cap // 8)
+        else:
+            new_cap = old_cap * 2
+        if min_capacity is not None and min_capacity > new_cap:
+            new_cap = min_capacity
+        if new_cap > _LARGE_ALIGN:
+            new_cap = -(-new_cap // _LARGE_ALIGN) * _LARGE_ALIGN
+        host = np.zeros((new_cap, self.features), dtype=self.dtype)
         host[:old_cap] = self._host
         self._host = host
         active = np.zeros(new_cap, dtype=bool)
         active[:old_cap] = self._active
         self._active = active
-        self._row_to_id.extend([None] * old_cap)
+        self._row_to_id.extend([None] * (new_cap - old_cap))
         self._free.extend(range(new_cap - 1, old_cap - 1, -1))
         self._device = None  # force full re-upload at next sync
         self._device_active = None
@@ -229,4 +272,4 @@ class FeatureVectorStore:
         host, active, row_ids = self.host_arrays()
         for row, id_ in enumerate(row_ids):
             if id_ is not None and active[row]:
-                fn(id_, host[row])
+                fn(id_, host[row].astype(np.float32))

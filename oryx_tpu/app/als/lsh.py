@@ -81,6 +81,7 @@ class LocalitySensitiveHash:
                  num_cores: int = 8):
         self.sample_rate = sample_rate
         self.num_features = num_features
+        self._hp_dev: jax.Array | None = None
         self.num_hashes, self.max_bits_differing = choose_hash_count(
             sample_rate, num_cores)
         rng = RandomManager.random()
@@ -100,21 +101,37 @@ class LocalitySensitiveHash:
     def num_partitions(self) -> int:
         return 1 << self.num_hashes
 
+    def _device_hyperplanes(self) -> jax.Array:
+        if self._hp_dev is None:
+            self._hp_dev = jnp.asarray(self.hyperplanes)
+        return self._hp_dev
+
     def bucket_of(self, vectors: np.ndarray) -> np.ndarray:
         """Bucket index for each row vector (reference getIndexFor :142)."""
         if self.num_hashes == 0:
             return np.zeros(len(vectors), dtype=np.int32)
-        return np.asarray(_bucket_kernel(jnp.asarray(vectors, jnp.float32),
-                                         jnp.asarray(self.hyperplanes),
-                                         self.num_hashes))
+        return np.asarray(self.device_buckets(jnp.asarray(vectors,
+                                                          jnp.float32)))
+
+    def device_buckets(self, vectors: jax.Array) -> jax.Array:
+        """Bucket ids computed device-to-device (no host round trip; the
+        input may be the serving model's whole resident item matrix)."""
+        if self.num_hashes == 0:
+            return jnp.zeros(vectors.shape[0], dtype=jnp.int32)
+        return _bucket_kernel(vectors, self._device_hyperplanes(),
+                              self.num_hashes)
 
     def candidate_mask(self, query_vector: np.ndarray,
                        item_buckets: jax.Array) -> jax.Array:
         """Device-side bool mask of items within the Hamming ball of the
-        query's bucket (reference getCandidateIndices :156-177 as a mask)."""
+        query's bucket (reference getCandidateIndices :156-177 as a mask).
+        Fully asynchronous: the target bucket is computed on device too,
+        so building the mask never blocks on a host round trip."""
         if self.num_hashes == 0 or self.max_bits_differing >= self.num_hashes:
             return jnp.ones(item_buckets.shape, dtype=bool)
-        target = int(self.bucket_of(query_vector[None, :])[0])
+        q = jnp.asarray(np.asarray(query_vector, np.float32)[None, :])
+        target = _bucket_kernel(q, self._device_hyperplanes(),
+                                self.num_hashes)[0]
         diff = _popcount(jnp.bitwise_xor(item_buckets, target))
         return diff <= self.max_bits_differing
 

@@ -38,6 +38,11 @@ class ALSServingModelManager(AbstractServingModelManager):
         self.rescorer_provider = load_rescorer_providers(
             config.get_optional_string("oryx.als.rescorer-provider-class"))
         self.sample_rate = config.get_double("oryx.als.sample-rate")
+        self.factor_dtype = config.get_string("oryx.als.factor-dtype")
+        # fail at boot, not hours later on the consumer thread when the
+        # first MODEL message finally constructs the serving model
+        from .feature_vectors import resolve_dtype
+        resolve_dtype(self.factor_dtype)
         self.min_model_load_fraction = config.get_double(
             "oryx.serving.min-model-load-fraction")
         if not 0.0 < self.sample_rate <= 1.0:
@@ -82,7 +87,8 @@ class ALSServingModelManager(AbstractServingModelManager):
                              "creating new one")
                 self.model = ALSServingModel(features, implicit,
                                              self.sample_rate,
-                                             self.rescorer_provider)
+                                             self.rescorer_provider,
+                                             dtype=self.factor_dtype)
             _log.info("Updating model")
             x_ids = set(pmml_io.get_extension_content(pmml, "XIDs") or [])
             y_ids = set(pmml_io.get_extension_content(pmml, "YIDs") or [])

@@ -36,7 +36,7 @@ import numpy as np
 from ...api.serving import ServingModel
 from ...common.lang import AutoReadWriteLock
 from .factor_model import FactorModelBase, SolverCache  # noqa: F401 (re-export)
-from .lsh import LocalitySensitiveHash
+from .lsh import LocalitySensitiveHash, _popcount
 from .rescorer import Rescorer
 
 __all__ = ["ALSServingModel", "SolverCache"]
@@ -48,6 +48,16 @@ def _pad_k(k: int) -> int:
     return 1 << max(3, (k - 1).bit_length())
 
 
+# Above this many bytes of (B, N) score matrix, the batched kernel
+# streams the item matrix in row chunks with a running top-k carry
+# instead of materializing all scores: 1024 queries x 20M items would
+# otherwise need an 80 GB buffer.  Chunk rows stay a power of two
+# <= feature_vectors._LARGE_ALIGN so every store capacity (pow2 or
+# multiple of 2^17) divides evenly.
+_FLAT_SCORES_LIMIT = 1 << 30
+_MAX_CHUNK_ROWS = 1 << 17
+
+
 @jax.jit
 def _dot_scores(Y, x):
     return jnp.matmul(Y, x, preferred_element_type=jnp.float32)
@@ -57,11 +67,23 @@ def _dot_scores(Y, x):
 def _cosine_mean_scores(Y, V):
     """Mean cosine similarity of each row of Y to each column vector in V
     (reference: CosineAverageFunction.java:25)."""
+    # bf16-stored factors: norms must accumulate in f32 like the dot
+    # kernels do, or 250-term squared sums lose ~1% per item norm
+    Y = Y.astype(jnp.float32)
     y_norm = jnp.linalg.norm(Y, axis=1, keepdims=True)
     v_norm = jnp.linalg.norm(V, axis=0, keepdims=True)
     denom = jnp.maximum(y_norm * v_norm, 1e-12)
     return jnp.mean(jnp.matmul(Y, V, preferred_element_type=jnp.float32)
                     / denom, axis=1)
+
+
+def _query_buckets(Q, hyperplanes):
+    """LSH bucket id per query row, on device (no host round trip —
+    matters when the device sits behind a high-latency transport).
+    Delegates to the same kernel that bucketed the items, so query and
+    item bucket ids can never drift apart."""
+    from .lsh import _bucket_kernel
+    return _bucket_kernel(Q, hyperplanes, int(hyperplanes.shape[0]))
 
 
 @partial(jax.jit, static_argnames=("k",))
@@ -75,6 +97,62 @@ def _batch_top_n_kernel(Y, Q, active, k: int):
     return jax.lax.top_k(scores, k)
 
 
+@partial(jax.jit, static_argnames=("k", "max_bits"))
+def _batch_top_n_lsh_kernel(Y, Q, active, buckets, hyperplanes,
+                            k: int, max_bits: int):
+    """Batched top-k with the LSH Hamming-ball candidate mask fused in:
+    each query's target bucket is computed on device and compared to the
+    per-item bucket ids — the whole approximate query stays one dispatch
+    (reference scans selected partitions on a thread pool instead,
+    ALSServingModel.java:265-280)."""
+    target = _query_buckets(Q, hyperplanes)
+    scores = jnp.matmul(Q, Y.T, preferred_element_type=jnp.float32)
+    ok = active[None, :] & (
+        _popcount(jnp.bitwise_xor(buckets[None, :], target[:, None]))
+        <= max_bits)
+    return jax.lax.top_k(jnp.where(ok, scores, -jnp.inf), k)
+
+
+@partial(jax.jit, static_argnames=("k", "chunk", "max_bits"))
+def _batch_top_n_chunked_kernel(Y, Q, active, buckets, hyperplanes,
+                                k: int, chunk: int, max_bits: int):
+    """Streaming batched top-k: lax.scan over item-row chunks carrying
+    the running (B, k) best scores/indices, so HBM holds one
+    (B, chunk) score tile instead of (B, N).  This is what makes the
+    reference's largest published model (21M ids x 250 features,
+    docs/docs/performance.html) servable from one chip.  ``buckets`` /
+    ``hyperplanes`` of None select the exact scan."""
+    n_chunks = Y.shape[0] // chunk
+    Yr = Y.reshape(n_chunks, chunk, Y.shape[1])
+    Ar = active.reshape(n_chunks, chunk)
+    xs = (Yr, Ar, jnp.arange(n_chunks, dtype=jnp.int32) * chunk)
+    target = None
+    if buckets is not None:
+        xs = xs + (buckets.reshape(n_chunks, chunk),)
+        target = _query_buckets(Q, hyperplanes)
+
+    def step(carry, x):
+        best_s, best_i = carry
+        Yc, Ac, base = x[:3]
+        scores = jnp.matmul(Q, Yc.T, preferred_element_type=jnp.float32)
+        ok = Ac[None, :]
+        if target is not None:
+            ok = ok & (_popcount(jnp.bitwise_xor(x[3][None, :],
+                                                 target[:, None]))
+                       <= max_bits)
+        cs, ci = jax.lax.top_k(jnp.where(ok, scores, -jnp.inf), k)
+        ns, sel = jax.lax.top_k(jnp.concatenate([best_s, cs], axis=1), k)
+        ni = jnp.take_along_axis(
+            jnp.concatenate([best_i, ci + base], axis=1), sel, axis=1)
+        return (ns, ni), None
+
+    b = Q.shape[0]
+    init = (jnp.full((b, k), -jnp.inf, jnp.float32),
+            jnp.zeros((b, k), jnp.int32))
+    (best_s, best_i), _ = jax.lax.scan(step, init, xs)
+    return best_s, best_i
+
+
 @partial(jax.jit, static_argnames=("k",))
 def _masked_top_k(scores, mask, k: int):
     masked = jnp.where(mask, scores, -jnp.inf)
@@ -85,8 +163,9 @@ class ALSServingModel(FactorModelBase, ServingModel):
     """Factor stores + known-items, with device top-N."""
 
     def __init__(self, features: int, implicit: bool,
-                 sample_rate: float = 1.0, rescorer_provider=None):
-        super().__init__(features, implicit)
+                 sample_rate: float = 1.0, rescorer_provider=None,
+                 dtype="float32"):
+        super().__init__(features, implicit, dtype=dtype)
         self.rescorer_provider = rescorer_provider
         self._known_items: dict[str, set[str]] = {}
         # incremental item -> #users-who-know-it counts, maintained on
@@ -148,15 +227,21 @@ class ALSServingModel(FactorModelBase, ServingModel):
 
     # -- scoring -------------------------------------------------------------
 
-    def _lsh_mask(self, query_vec: np.ndarray | None, vecs, version, active):
-        if self.lsh is None or query_vec is None:
-            return active
+    def _cached_buckets(self, vecs, version) -> jax.Array:
+        """Per-item LSH bucket ids on device, recomputed only when the Y
+        snapshot version changes.  Computed device-to-device: at 20M
+        items the vectors never round-trip through the host."""
         with self._bucket_lock:
-            if self._item_buckets is None or self._item_buckets_version != version:
-                self._item_buckets = jnp.asarray(
-                    self.lsh.bucket_of(np.asarray(vecs)))
+            if self._item_buckets is None \
+                    or self._item_buckets_version != version:
+                self._item_buckets = self.lsh.device_buckets(vecs)
                 self._item_buckets_version = version
-            buckets = self._item_buckets
+            return self._item_buckets
+
+    def _lsh_mask(self, query_vec: np.ndarray | None, vecs, version, active):
+        if self.lsh is None or query_vec is None or self.lsh.num_hashes == 0:
+            return active
+        buckets = self._cached_buckets(vecs, version)
         return active & self.lsh.candidate_mask(query_vec, buckets)
 
     def top_n(self, how_many: int,
@@ -216,17 +301,23 @@ class ALSServingModel(FactorModelBase, ServingModel):
 
     def top_n_batch(self, how_many: int | Sequence[int],
                     user_vectors: np.ndarray,
-                    exclude: Sequence[Iterable[str]] | None = None
-                    ) -> list[list[tuple[str, float]]]:
-        """Batched exact top-N: one device dispatch for a whole batch of
+                    exclude: Sequence[Iterable[str]] | None = None,
+                    use_lsh: bool = True) -> list[list[tuple[str, float]]]:
+        """Batched top-N: one device dispatch for a whole batch of
         /recommend requests.  ``user_vectors`` is (B, features);
         ``how_many`` is one size for all requests or one per request;
         ``exclude`` optionally gives per-request excluded item IDs.
         Rescorers/allowed-predicates take the single-request path.
 
+        On an LSH-configured model each query's Hamming-ball candidate
+        mask is fused into the same dispatch (per-query target buckets
+        computed on device).  ``use_lsh=False`` forces the exact scan.
+
         The batch dimension is zero-padded up to a power of two so the
         request micro-batcher's varying drain sizes hit a handful of
-        compiled shapes instead of one XLA program per batch size."""
+        compiled shapes, and above ~1 GB of score matrix the kernel
+        streams item-row chunks with a running top-k carry instead of
+        materializing (B, N) scores."""
         Q = np.asarray(user_vectors, dtype=np.float32)
         if Q.ndim != 2 or Q.shape[1] != self.features:
             raise ValueError("user_vectors must be (B, features)")
@@ -239,19 +330,38 @@ class ALSServingModel(FactorModelBase, ServingModel):
             raise ValueError("one how_many per user vector required")
         excl = [set(e) for e in exclude] if exclude is not None \
             else [set()] * n_req
-        vecs, active, _ = self.Y.device_arrays_versioned()
-        k = min(_pad_k(max(h + len(e) for h, e in zip(hm, excl))),
-                int(vecs.shape[0]))
+        vecs, active, version = self.Y.device_arrays_versioned()
+        n_rows = int(vecs.shape[0])
+        k = min(_pad_k(max(h + len(e) for h, e in zip(hm, excl))), n_rows)
         # floor of 8: a (1,F)x(F,N) matvec hits a much slower XLA path
         # than a small batched matmul, and zero rows are free
         b_pad = 1 << max(3, (n_req - 1).bit_length())
         if b_pad != n_req:
             Q = np.concatenate(
                 [Q, np.zeros((b_pad - n_req, Q.shape[1]), np.float32)])
+        lsh_on = (use_lsh and self.lsh is not None
+                  and self.lsh.num_hashes > 0
+                  and self.lsh.max_bits_differing < self.lsh.num_hashes)
+        buckets = self._cached_buckets(vecs, version) if lsh_on else None
+        Qd = jnp.asarray(Q)
+        chunk = _MAX_CHUNK_ROWS
+        while chunk > 1024 and b_pad * chunk * 4 > _FLAT_SCORES_LIMIT:
+            chunk //= 2
+        if b_pad * n_rows * 4 > _FLAT_SCORES_LIMIT and n_rows % chunk == 0 \
+                and k <= chunk:
+            out_dev = _batch_top_n_chunked_kernel(
+                vecs, Qd, active, buckets,
+                self.lsh._device_hyperplanes() if lsh_on else None,
+                k, chunk, self.lsh.max_bits_differing if lsh_on else 0)
+        elif lsh_on:
+            out_dev = _batch_top_n_lsh_kernel(
+                vecs, Qd, active, buckets, self.lsh._device_hyperplanes(),
+                k, self.lsh.max_bits_differing)
+        else:
+            out_dev = _batch_top_n_kernel(vecs, Qd, active, k)
         # fetch both outputs in ONE host round-trip (matters when the
         # device sits behind a high-latency transport)
-        top_scores, top_idx = jax.device_get(
-            _batch_top_n_kernel(vecs, jnp.asarray(Q), active, k))
+        top_scores, top_idx = jax.device_get(out_dev)
         row_ids = self.Y.row_ids()
         results: list[list[tuple[str, float]]] = []
         for b in range(n_req):
@@ -265,11 +375,11 @@ class ALSServingModel(FactorModelBase, ServingModel):
                 out.append((id_, s))
                 if len(out) == hm[b]:
                     break
-            if len(out) < hm[b] and k < int(vecs.shape[0]):
-                # this request's exclusions ate its window; redo exactly
-                # (no LSH mask — the batch path is an exact scan)
+            if len(out) < hm[b] and k < n_rows:
+                # this request's exclusions ate its window; redo with the
+                # same scan semantics on the single-request path
                 out = self.top_n(hm[b], user_vector=user_vectors[b],
-                                 exclude=excl[b], use_lsh=False)
+                                 exclude=excl[b], use_lsh=use_lsh)
             results.append(out)
         return results
 

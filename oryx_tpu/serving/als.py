@@ -123,10 +123,12 @@ def _dot_top_n(req: Request, model: ALSServingModel, how_many: int,
                user_vector: np.ndarray, exclude: set[str],
                rescorer) -> list[tuple[str, float]]:
     """Dot-product top-N, coalesced with concurrent requests through the
-    app-scope TopNBatcher when exact-scan semantics allow (no rescorer
-    plugin, no LSH mask)."""
+    app-scope TopNBatcher unless a rescorer plugin forces the exact
+    single-request path.  LSH-configured models batch too: per-query
+    Hamming-ball masks are fused into the shared dispatch
+    (ALSServingModel.top_n_batch)."""
     batcher = req.context.get("top_n_batcher")
-    if batcher is not None and rescorer is None and model.lsh is None:
+    if batcher is not None and rescorer is None:
         return batcher.top_n(model, how_many, user_vector, exclude)
     return model.top_n(how_many, user_vector=user_vector, exclude=exclude,
                        rescorer=rescorer)

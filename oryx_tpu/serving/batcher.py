@@ -69,7 +69,9 @@ class TopNBatcher:
     def top_n(self, model, how_many: int, user_vector: np.ndarray,
               exclude: Iterable[str] = ()) -> list[tuple[str, float]]:
         """Blocking submit; returns the same pairs as ``model.top_n``
-        (exact scan, dot-product scores)."""
+        (dot-product scores; on an LSH-configured model the batched
+        dispatch applies the same Hamming-ball candidate mask the
+        single-request path would)."""
         job = _Job(model, how_many,
                    np.asarray(user_vector, dtype=np.float32), set(exclude))
         with self._cond:

@@ -567,3 +567,85 @@ def test_bulk_load_overwrites_and_grows():
     np.testing.assert_array_equal(store.get_vector("x7"), M2[7])
     vecs, active = store.device_arrays()
     assert int(np.asarray(active).sum()) == 100
+
+
+def test_feature_store_bfloat16_storage():
+    from oryx_tpu.app.als.feature_vectors import FeatureVectorStore
+    store = FeatureVectorStore(4, dtype="bfloat16")
+    v = np.array([1.5, -2.25, 0.125, 3.0], np.float32)  # bf16-exact values
+    store.set_vector("a", v)
+    got = store.get_vector("a")
+    assert got.dtype == np.float32
+    np.testing.assert_array_equal(got, v)
+    vecs, active = store.device_arrays()
+    assert str(vecs.dtype) == "bfloat16"
+    # device matmul still accumulates f32 and round-trips the values
+    model_scores = np.asarray(store.vtv())
+    assert model_scores.dtype == np.float32
+
+
+def test_bulk_load_exact_fit_capacity():
+    from oryx_tpu.app.als.feature_vectors import (FeatureVectorStore,
+                                                  _LARGE_ALIGN)
+    store = FeatureVectorStore(2, initial_capacity=16)
+    n = _LARGE_ALIGN + 5000
+    ids = [str(i) for i in range(n)]
+    store.bulk_load(ids, np.zeros((n, 2), np.float32))
+    cap = len(store.row_ids())
+    # large stores size to the next chunk multiple, not the next pow2
+    assert cap % _LARGE_ALIGN == 0
+    assert cap - n < _LARGE_ALIGN
+
+
+def test_top_n_batch_chunked_matches_flat(monkeypatch):
+    from oryx_tpu.app.als import serving_model as sm
+    rng = np.random.default_rng(3)
+    ni, k = 1500, 8
+    model = ALSServingModel(k, implicit=True)
+    Y = rng.standard_normal((ni, k)).astype(np.float32)
+    model.Y.bulk_load([f"i{j}" for j in range(ni)], Y)
+    Q = rng.standard_normal((5, k)).astype(np.float32)
+    flat = model.top_n_batch(6, Q)
+    monkeypatch.setattr(sm, "_FLAT_SCORES_LIMIT", 1)
+    monkeypatch.setattr(sm, "_MAX_CHUNK_ROWS", 256)
+    chunked = model.top_n_batch(6, Q)
+    for f, c in zip(flat, chunked):
+        assert [i for i, _ in f] == [i for i, _ in c]
+        np.testing.assert_allclose([s for _, s in f], [s for _, s in c],
+                                   rtol=1e-5)
+
+
+def test_top_n_batch_lsh_matches_single():
+    rng = np.random.default_rng(4)
+    ni, k = 3000, 8
+    model = ALSServingModel(k, implicit=True, sample_rate=0.3)
+    assert model.lsh is not None and model.lsh.num_hashes > 0
+    model.Y.bulk_load([f"i{j}" for j in range(ni)],
+                      rng.standard_normal((ni, k)).astype(np.float32))
+    Q = rng.standard_normal((4, k)).astype(np.float32)
+    batched = model.top_n_batch(5, Q)
+    exact = model.top_n_batch(5, Q, use_lsh=False)
+    assert batched != exact  # the Hamming-ball mask actually pruned
+    for b in range(4):
+        single = model.top_n(5, user_vector=Q[b])
+        assert [i for i, _ in batched[b]] == [i for i, _ in single]
+        np.testing.assert_allclose([s for _, s in batched[b]],
+                                   [s for _, s in single], rtol=1e-5)
+
+
+def test_top_n_batch_chunked_lsh(monkeypatch):
+    from oryx_tpu.app.als import serving_model as sm
+    rng = np.random.default_rng(6)
+    ni, k = 1800, 8
+    model = ALSServingModel(k, implicit=True, sample_rate=0.3)
+    model.Y.bulk_load([f"i{j}" for j in range(ni)],
+                      rng.standard_normal((ni, k)).astype(np.float32))
+    Q = rng.standard_normal((3, k)).astype(np.float32)
+    flat = model.top_n_batch(5, Q)
+    monkeypatch.setattr(sm, "_FLAT_SCORES_LIMIT", 1)
+    monkeypatch.setattr(sm, "_MAX_CHUNK_ROWS", 256)
+    chunked = model.top_n_batch(5, Q)
+    for f, c in zip(flat, chunked):
+        assert [i for i, _ in f] == [i for i, _ in c]
+        np.testing.assert_allclose([s for _, s in f], [s for _, s in c],
+                                   rtol=1e-5)
