@@ -78,6 +78,11 @@ class FeatureVectorStore:
         self._device_version = 0
         self._recent: set[str] = set()
         self._lock = AutoReadWriteLock()
+        # row->id snapshot cache for the serving hot path; invalidated
+        # by bumping _mutations under the write lock
+        self._mutations = 0
+        self._row_ids_cache: list[str | None] | None = None
+        self._row_ids_mutations = -1
 
     # -- basic map ops ------------------------------------------------------
 
@@ -120,6 +125,7 @@ class FeatureVectorStore:
                 row = self._free.pop()
                 self._id_to_row[id_] = row
                 self._row_to_id[row] = id_
+                self._mutations += 1
             self._host[row] = vector
             self._active[row] = True
             self._dirty.add(row)
@@ -151,6 +157,7 @@ class FeatureVectorStore:
                     row = self._free.pop()
                     self._id_to_row[id_] = row
                     self._row_to_id[row] = id_
+                    self._mutations += 1
                 rows[j] = row
             self._host[rows] = matrix
             self._active[rows] = True
@@ -162,6 +169,7 @@ class FeatureVectorStore:
             row = self._id_to_row.pop(id_, None)
             if row is not None:
                 self._row_to_id[row] = None
+                self._mutations += 1
                 self._host[row] = 0.0
                 self._active[row] = False
                 self._dirty.add(row)
@@ -182,6 +190,7 @@ class FeatureVectorStore:
             for id_ in [i for i in self._id_to_row if i not in keep]:
                 row = self._id_to_row.pop(id_)
                 self._row_to_id[row] = None
+                self._mutations += 1
                 self._host[row] = 0.0
                 self._active[row] = False
                 self._dirty.add(row)
@@ -208,6 +217,7 @@ class FeatureVectorStore:
         active[:old_cap] = self._active
         self._active = active
         self._row_to_id.extend([None] * (new_cap - old_cap))
+        self._mutations += 1
         self._free.extend(range(new_cap - 1, old_cap - 1, -1))
         self._device = None  # force full re-upload at next sync
         self._device_active = None
@@ -251,10 +261,16 @@ class FeatureVectorStore:
             return self._device_version
 
     def row_ids(self) -> list[str | None]:
-        """Snapshot of the row -> id table (one lock acquisition, for
-        batched result decoding)."""
+        """Snapshot of the row -> id table for batched result decoding.
+        Cached against the mutation counter: the serving hot path calls
+        this once per device dispatch, and copying a 20M-entry table per
+        request batch would cost more than the scoring itself."""
         with self._lock.read():
-            return list(self._row_to_id)
+            if self._row_ids_cache is None \
+                    or self._row_ids_mutations != self._mutations:
+                self._row_ids_cache = list(self._row_to_id)
+                self._row_ids_mutations = self._mutations
+            return self._row_ids_cache
 
     def host_arrays(self) -> tuple[np.ndarray, np.ndarray, list[str | None]]:
         """Copy of (vectors, active, row->id) for host-side iteration."""

@@ -57,6 +57,14 @@ def _pad_k(k: int) -> int:
 _FLAT_SCORES_LIMIT = 1 << 30
 _MAX_CHUNK_ROWS = 1 << 17
 
+# The chunked path pads every request batch to this fixed size and
+# splits bigger drains into windows of it.  Streaming the item matrix
+# from HBM dominates the dispatch up to roughly B = peak_flops /
+# memory_bw (~240 on v5e), so one fixed batch shape costs the same
+# device time as pow2 buckets would — and the 20M x 250 scan kernel
+# compiles ONCE instead of once per drain-size bucket.
+_CHUNKED_BATCH = 256
+
 
 @jax.jit
 def _dot_scores(Y, x):
@@ -343,25 +351,43 @@ class ALSServingModel(FactorModelBase, ServingModel):
                   and self.lsh.num_hashes > 0
                   and self.lsh.max_bits_differing < self.lsh.num_hashes)
         buckets = self._cached_buckets(vecs, version) if lsh_on else None
-        Qd = jnp.asarray(Q)
         chunk = _MAX_CHUNK_ROWS
-        while chunk > 1024 and b_pad * chunk * 4 > _FLAT_SCORES_LIMIT:
+        while chunk > 1024 and _CHUNKED_BATCH * chunk * 4 > _FLAT_SCORES_LIMIT:
             chunk //= 2
-        if b_pad * n_rows * 4 > _FLAT_SCORES_LIMIT and n_rows % chunk == 0 \
-                and k <= chunk:
-            out_dev = _batch_top_n_chunked_kernel(
-                vecs, Qd, active, buckets,
-                self.lsh._device_hyperplanes() if lsh_on else None,
-                k, chunk, self.lsh.max_bits_differing if lsh_on else 0)
-        elif lsh_on:
-            out_dev = _batch_top_n_lsh_kernel(
-                vecs, Qd, active, buckets, self.lsh._device_hyperplanes(),
-                k, self.lsh.max_bits_differing)
+        # stream whenever the item matrix is big: above ~2M rows every
+        # drain size shares ONE compiled scan (the fixed _CHUNKED_BATCH
+        # shape) instead of compiling the 10-GB-matmul per pow2 bucket
+        big = (n_rows > (1 << 21)
+               or b_pad * n_rows * 4 > _FLAT_SCORES_LIMIT)
+        if big and n_rows % chunk == 0 and k <= chunk:
+            # streaming path: fixed batch shape, oversize drains become
+            # windows whose dispatches overlap (async) before ONE fetch
+            hp = self.lsh._device_hyperplanes() if lsh_on else None
+            mb = self.lsh.max_bits_differing if lsh_on else 0
+            if Q.shape[0] < _CHUNKED_BATCH:
+                Q = np.concatenate(
+                    [Q, np.zeros((_CHUNKED_BATCH - Q.shape[0], Q.shape[1]),
+                                 np.float32)])
+            outs = [
+                _batch_top_n_chunked_kernel(
+                    vecs, jnp.asarray(Q[w:w + _CHUNKED_BATCH]), active,
+                    buckets, hp, k, chunk, mb)
+                for w in range(0, Q.shape[0], _CHUNKED_BATCH)]
+            fetched = jax.device_get(outs)
+            top_scores = np.concatenate([f[0] for f in fetched])
+            top_idx = np.concatenate([f[1] for f in fetched])
         else:
-            out_dev = _batch_top_n_kernel(vecs, Qd, active, k)
-        # fetch both outputs in ONE host round-trip (matters when the
-        # device sits behind a high-latency transport)
-        top_scores, top_idx = jax.device_get(out_dev)
+            Qd = jnp.asarray(Q)
+            if lsh_on:
+                out_dev = _batch_top_n_lsh_kernel(
+                    vecs, Qd, active, buckets,
+                    self.lsh._device_hyperplanes(), k,
+                    self.lsh.max_bits_differing)
+            else:
+                out_dev = _batch_top_n_kernel(vecs, Qd, active, k)
+            # fetch both outputs in ONE host round-trip (matters when the
+            # device sits behind a high-latency transport)
+            top_scores, top_idx = jax.device_get(out_dev)
         row_ids = self.Y.row_ids()
         results: list[list[tuple[str, float]]] = []
         for b in range(n_req):

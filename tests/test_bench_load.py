@@ -69,3 +69,28 @@ def test_bench_apps_small_scale():
                     max_depth=3)
     assert rdf["warm_total_s"] > 0
     assert 0.5 < rdf["train_accuracy"] <= 1.0
+
+
+def test_grid_bench_toy_scale(monkeypatch):
+    """The full-grid serving bench harness runs end to end at toy scale
+    (the recorded BENCH_GRID artifact uses this code at reference scale
+    on the chip): both LSH modes, warm-up, calibration, low-concurrency
+    latency."""
+    from oryx_tpu.bench import grid
+
+    monkeypatch.setattr(grid, "SAT_WORKERS", 4)
+    monkeypatch.setattr(grid, "LOW_REQUESTS", 8)
+    monkeypatch.setattr(grid, "MEASURE_SEC", 0.3)
+    monkeypatch.setattr(grid, "N_USERS", 50)
+    monkeypatch.setitem(grid.BASELINES, (4, 0, False), (10, 10))
+    monkeypatch.setitem(grid.BASELINES, (4, 0, True), (10, 10))
+    rng = np.random.default_rng(0)
+    model, user_ids = grid.build_model(4, 600, rng)
+    assert str(model.Y.device_arrays()[0].dtype) == "bfloat16"
+    rows = grid.bench_config(4, 0, model, user_ids, tunnel_floor_ms=0.0)
+    assert len(rows) == 2
+    for r in rows:
+        assert r["qps"] > 0 and r["qps_errors"] == 0
+        assert np.isfinite(r["p50_ms_at_2_workers"])
+    assert rows[0]["lsh"] is False and rows[1]["lsh"] is True
+    assert model.lsh is not None  # restored after the exact rows

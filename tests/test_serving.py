@@ -515,3 +515,57 @@ def test_https_silent_client_does_not_block_others(tmp_path):
             s.close()
     finally:
         layer.close()
+
+
+def test_oversized_header_line_rejected(server):
+    import socket
+    with socket.create_connection(("127.0.0.1", server.port), timeout=10) as s:
+        s.sendall(b"GET /ready HTTP/1.1\r\nHost: a\r\n"
+                  b"X-Big: " + b"a" * 70000 + b"\r\n\r\n")
+        resp = s.makefile("rb").readline()
+    assert b"400" in resp
+
+
+def test_too_many_headers_rejected(server):
+    import socket
+    with socket.create_connection(("127.0.0.1", server.port), timeout=10) as s:
+        s.sendall(b"GET /ready HTTP/1.1\r\nHost: a\r\n"
+                  + b"".join(b"X-H%d: v\r\n" % i for i in range(200))
+                  + b"\r\n")
+        resp = s.makefile("rb").readline()
+    assert b"400" in resp
+
+
+def test_expect_100_continue_interim_response(server):
+    import socket
+    body = b"U9,I9,1.0"
+    with socket.create_connection(("127.0.0.1", server.port), timeout=10) as s:
+        s.sendall(b"POST /ingest HTTP/1.1\r\nHost: a\r\n"
+                  b"Expect: 100-continue\r\n"
+                  b"Content-Length: %d\r\n\r\n" % len(body))
+        r = s.makefile("rb")
+        interim = r.readline()
+        assert interim.startswith(b"HTTP/1.1 100"), interim
+        assert r.readline() in (b"\r\n", b"\n")
+        s.sendall(body)
+        final = r.readline()
+    assert b"200" in final or b"204" in final, final
+
+
+def test_keep_alive_multiple_requests_one_connection(server):
+    import socket
+    with socket.create_connection(("127.0.0.1", server.port), timeout=10) as s:
+        r = s.makefile("rb")
+        for _ in range(3):
+            s.sendall(b"GET /ready HTTP/1.1\r\nHost: a\r\n\r\n")
+            status = r.readline()
+            assert b"204" in status  # /ready responds No Content
+            clen = 0
+            while True:
+                h = r.readline()
+                if h in (b"\r\n", b"\n", b""):
+                    break
+                if h.lower().startswith(b"content-length:"):
+                    clen = int(h.split(b":")[1])
+            if clen:
+                r.read(clen)
