@@ -85,6 +85,16 @@ def _cosine_mean_scores(Y, V):
                     / denom, axis=1)
 
 
+def _lsh_ok(ok, buckets, target, max_bits: int):
+    """Fuse the LSH Hamming-ball candidate test into a mask: ok AND
+    popcount(bucket XOR target) <= max_bits.  The single definition all
+    four scoring kernels share — the candidate-set invariant must not
+    be able to diverge between the exact, streaming, and two-phase
+    paths (the exactness certificate assumes phase A and phase B agree
+    bit-for-bit)."""
+    return ok & (_popcount(jnp.bitwise_xor(buckets, target)) <= max_bits)
+
+
 def _query_buckets(Q, hyperplanes):
     """LSH bucket id per query row, on device (no host round trip —
     matters when the device sits behind a high-latency transport).
@@ -115,20 +125,103 @@ def _batch_top_n_lsh_kernel(Y, Q, active, buckets, hyperplanes,
     ALSServingModel.java:265-280)."""
     target = _query_buckets(Q, hyperplanes)
     scores = jnp.matmul(Q, Y.T, preferred_element_type=jnp.float32)
-    ok = active[None, :] & (
-        _popcount(jnp.bitwise_xor(buckets[None, :], target[:, None]))
-        <= max_bits)
+    ok = _lsh_ok(active[None, :], buckets[None, :], target[:, None],
+                 max_bits)
     return jax.lax.top_k(jnp.where(ok, scores, -jnp.inf), k)
+
+
+def _stream_plan(n_rows: int, b_pad: int) -> tuple[bool, int]:
+    """(use_streaming_path, chunk_rows) for a batch of ``b_pad`` queries
+    over ``n_rows`` items.  Stream whenever the item matrix is big:
+    above ~2M rows every drain size shares ONE compiled scan (the fixed
+    _CHUNKED_BATCH shape) instead of compiling the 10-GB-matmul per
+    pow2 batch bucket."""
+    chunk = _MAX_CHUNK_ROWS
+    while chunk > 1024 and _CHUNKED_BATCH * chunk * 4 > _FLAT_SCORES_LIMIT:
+        chunk //= 2
+    big = (n_rows > (1 << 21)
+           or b_pad * n_rows * 4 > _FLAT_SCORES_LIMIT)
+    return big, chunk
+
+
+# Two-phase streaming top-k tuning: 128-row blocks match the TPU's
+# lane granularity (a block gather moves aligned ~13-64 KB slabs, not
+# sub-tile rows), and recall 0.999 on the block-selection approx_max_k
+# makes the exactness certificate pass >99.99% of dispatches on random
+# factors while staying ~8x faster than an exact lax.top_k scan.
+_BLOCK_ROWS = 128
+_BLOCK_KSEL = 32
+_APPROX_RECALL = 0.999
+
+
+@partial(jax.jit, static_argnames=("k", "chunk", "bs", "ksel", "max_bits"))
+def _batch_top_n_twophase_kernel(Y, Q, active, buckets, hyperplanes,
+                                 k: int, chunk: int, bs: int, ksel: int,
+                                 max_bits: int):
+    """Streaming batched top-k, two-phase MIPS style, EXACT with a
+    per-row certificate.
+
+    Phase A scans the item matrix in row chunks and keeps only per-
+    128-row-block score maxima (one (B, chunk) tile live in HBM, never
+    (B, N) — what makes the reference's largest published model, 21M ids
+    x 250 features, servable from one chip).  Phase B picks the ``ksel``
+    best blocks per query with approx_max_k (the TPU-native partial
+    reduce; a full lax.top_k over a multi-million-row chunk lowers to a
+    per-row sort that costs ~40x the matmul itself), exactly rescores
+    those blocks from gathered rows, and emits top-k plus a certificate:
+    kth_score >= max(every unselected block's maximum) proves no
+    unscanned block can hold a better item.  Rows whose certificate
+    fails (approx selection missed a head block) are recomputed by the
+    caller on the exact lax.top_k scan path.  ``buckets`` /
+    ``hyperplanes`` of None select the exact scan; with LSH they fuse
+    the Hamming-ball mask into both phases."""
+    b = Q.shape[0]
+    n_chunks = Y.shape[0] // chunk
+    Yr = Y.reshape(n_chunks, chunk, Y.shape[1])
+    Ar = active.reshape(n_chunks, chunk)
+    xs = (Yr, Ar)
+    target = None
+    if buckets is not None:
+        xs = xs + (buckets.reshape(n_chunks, chunk),)
+        target = _query_buckets(Q, hyperplanes)
+
+    def step_a(_, x):
+        scores = jnp.matmul(Q, x[0].T, preferred_element_type=jnp.float32)
+        ok = x[1][None, :]
+        if target is not None:
+            ok = _lsh_ok(ok, x[2][None, :], target[:, None], max_bits)
+        scores = jnp.where(ok, scores, -jnp.inf)
+        return None, scores.reshape(b, chunk // bs, bs).max(-1)
+
+    _, Ms = jax.lax.scan(step_a, None, xs)
+    M = jnp.transpose(Ms, (1, 0, 2)).reshape(b, -1)   # (B, n_blocks)
+    _, bi = jax.lax.approx_max_k(M, ksel, recall_target=_APPROX_RECALL)
+    m_rest = M.at[jnp.arange(b)[:, None], bi].set(-jnp.inf).max(-1)
+    Yg = jnp.take(Y.reshape(-1, bs, Y.shape[1]), bi,
+                  axis=0).astype(jnp.float32)          # (B, ksel, bs, F)
+    scores = jnp.einsum("bf,bkcf->bkc", Q, Yg).reshape(b, ksel * bs)
+    ok = jnp.take(active.reshape(-1, bs), bi, axis=0).reshape(b, ksel * bs)
+    if target is not None:
+        bg = jnp.take(buckets.reshape(-1, bs), bi,
+                      axis=0).reshape(b, ksel * bs)
+        ok = _lsh_ok(ok, bg, target[:, None], max_bits)
+    scores = jnp.where(ok, scores, -jnp.inf)
+    ts, ti = jax.lax.top_k(scores, k)
+    rows = (bi[:, :, None] * bs
+            + jnp.arange(bs, dtype=jnp.int32)[None, None, :]).reshape(
+                b, ksel * bs)
+    idx = jnp.take_along_axis(rows, ti, axis=1)
+    cert = ts[:, k - 1] >= m_rest
+    return ts, idx, cert
 
 
 @partial(jax.jit, static_argnames=("k", "chunk", "max_bits"))
 def _batch_top_n_chunked_kernel(Y, Q, active, buckets, hyperplanes,
                                 k: int, chunk: int, max_bits: int):
-    """Streaming batched top-k: lax.scan over item-row chunks carrying
-    the running (B, k) best scores/indices, so HBM holds one
-    (B, chunk) score tile instead of (B, N).  This is what makes the
-    reference's largest published model (21M ids x 250 features,
-    docs/docs/performance.html) servable from one chip.  ``buckets`` /
+    """Streaming batched top-k with exact per-chunk lax.top_k — the
+    certainty fallback for two-phase certificate failures (and the
+    reference semantics oracle in tests).  Carries the running (B, k)
+    best scores/indices across item-row chunks.  ``buckets`` /
     ``hyperplanes`` of None select the exact scan."""
     n_chunks = Y.shape[0] // chunk
     Yr = Y.reshape(n_chunks, chunk, Y.shape[1])
@@ -145,9 +238,7 @@ def _batch_top_n_chunked_kernel(Y, Q, active, buckets, hyperplanes,
         scores = jnp.matmul(Q, Yc.T, preferred_element_type=jnp.float32)
         ok = Ac[None, :]
         if target is not None:
-            ok = ok & (_popcount(jnp.bitwise_xor(x[3][None, :],
-                                                 target[:, None]))
-                       <= max_bits)
+            ok = _lsh_ok(ok, x[3][None, :], target[:, None], max_bits)
         cs, ci = jax.lax.top_k(jnp.where(ok, scores, -jnp.inf), k)
         ns, sel = jax.lax.top_k(jnp.concatenate([best_s, cs], axis=1), k)
         ni = jnp.take_along_axis(
@@ -187,6 +278,9 @@ class ALSServingModel(FactorModelBase, ServingModel):
         self._item_buckets: jax.Array | None = None
         self._item_buckets_version: int = -1
         self._bucket_lock = threading.Lock()
+        # observability: exact-scan recomputes forced by a failed
+        # two-phase certificate (expected ~0; see _APPROX_RECALL)
+        self.twophase_fallbacks = 0
 
     # -- known items ---------------------------------------------------------
 
@@ -234,6 +328,40 @@ class ALSServingModel(FactorModelBase, ServingModel):
                               if c > 0}
 
     # -- scoring -------------------------------------------------------------
+
+    def _lsh_active(self) -> bool:
+        """True when this model's LSH configuration actually prunes
+        (hashes exist and the Hamming ball is a strict subset)."""
+        return (self.lsh is not None and self.lsh.num_hashes > 0
+                and self.lsh.max_bits_differing < self.lsh.num_hashes)
+
+    def warm_serving_kernels(self, how_many: int = 10,
+                             max_batch: int = 1024) -> None:
+        """Compile every kernel variant the serving hot path can hit
+        for ``how_many``-sized requests before traffic arrives: each
+        pow2 batch bucket, and on streaming-path models ALSO the
+        exact-scan fallback, so a rare two-phase certificate failure
+        costs one extra dispatch instead of a multi-second XLA compile
+        inside a live request."""
+        b = 8
+        while b <= max_batch:
+            self.top_n_batch(how_many,
+                             np.zeros((b, self.features), np.float32))
+            b *= 2
+        vecs, active, version = self.Y.device_arrays_versioned()
+        n_rows = int(vecs.shape[0])
+        k = min(_pad_k(how_many), n_rows)
+        big, chunk = _stream_plan(n_rows, _CHUNKED_BATCH)
+        if big and n_rows % chunk == 0 and k <= chunk:
+            lsh_on = self._lsh_active()
+            buckets = self._cached_buckets(vecs, version) if lsh_on \
+                else None
+            hp = self.lsh._device_hyperplanes() if lsh_on else None
+            mb = self.lsh.max_bits_differing if lsh_on else 0
+            jax.device_get(_batch_top_n_chunked_kernel(
+                vecs,
+                jnp.zeros((_CHUNKED_BATCH, self.features), jnp.float32),
+                active, buckets, hp, k, chunk, mb))
 
     def _cached_buckets(self, vecs, version) -> jax.Array:
         """Per-item LSH bucket ids on device, recomputed only when the Y
@@ -347,18 +475,11 @@ class ALSServingModel(FactorModelBase, ServingModel):
         if b_pad != n_req:
             Q = np.concatenate(
                 [Q, np.zeros((b_pad - n_req, Q.shape[1]), np.float32)])
-        lsh_on = (use_lsh and self.lsh is not None
-                  and self.lsh.num_hashes > 0
-                  and self.lsh.max_bits_differing < self.lsh.num_hashes)
+        lsh_on = use_lsh and self._lsh_active()
         buckets = self._cached_buckets(vecs, version) if lsh_on else None
-        chunk = _MAX_CHUNK_ROWS
-        while chunk > 1024 and _CHUNKED_BATCH * chunk * 4 > _FLAT_SCORES_LIMIT:
-            chunk //= 2
-        # stream whenever the item matrix is big: above ~2M rows every
-        # drain size shares ONE compiled scan (the fixed _CHUNKED_BATCH
-        # shape) instead of compiling the 10-GB-matmul per pow2 bucket
-        big = (n_rows > (1 << 21)
-               or b_pad * n_rows * 4 > _FLAT_SCORES_LIMIT)
+        big, chunk = _stream_plan(n_rows, b_pad)
+        bs = _BLOCK_ROWS
+        ksel = min(_BLOCK_KSEL, n_rows // max(1, bs))
         if big and n_rows % chunk == 0 and k <= chunk:
             # streaming path: fixed batch shape, oversize drains become
             # windows whose dispatches overlap (async) before ONE fetch
@@ -368,12 +489,30 @@ class ALSServingModel(FactorModelBase, ServingModel):
                 Q = np.concatenate(
                     [Q, np.zeros((_CHUNKED_BATCH - Q.shape[0], Q.shape[1]),
                                  np.float32)])
-            outs = [
-                _batch_top_n_chunked_kernel(
-                    vecs, jnp.asarray(Q[w:w + _CHUNKED_BATCH]), active,
-                    buckets, hp, k, chunk, mb)
-                for w in range(0, Q.shape[0], _CHUNKED_BATCH)]
-            fetched = jax.device_get(outs)
+            windows = [jnp.asarray(Q[w:w + _CHUNKED_BATCH])
+                       for w in range(0, Q.shape[0], _CHUNKED_BATCH)]
+            if n_rows % bs == 0 and 1 <= ksel < n_rows // bs \
+                    and k <= ksel * bs:
+                fetched = jax.device_get([
+                    _batch_top_n_twophase_kernel(vecs, qw, active,
+                                                 buckets, hp, k, chunk,
+                                                 bs, ksel, mb)
+                    for qw in windows])
+                for w, (ts, ti, cert) in enumerate(fetched):
+                    if not cert.all():
+                        # approx block selection missed a head block for
+                        # some row; recompute on the exact scan
+                        self.twophase_fallbacks += 1
+                        ts, ti = jax.device_get(
+                            _batch_top_n_chunked_kernel(
+                                vecs, windows[w], active, buckets, hp,
+                                k, chunk, mb))
+                        fetched[w] = (ts, ti, None)
+            else:
+                fetched = jax.device_get([
+                    _batch_top_n_chunked_kernel(vecs, qw, active,
+                                                buckets, hp, k, chunk, mb)
+                    for qw in windows])
             top_scores = np.concatenate([f[0] for f in fetched])
             top_idx = np.concatenate([f[1] for f in fetched])
         else:

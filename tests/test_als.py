@@ -649,3 +649,63 @@ def test_top_n_batch_chunked_lsh(monkeypatch):
         assert [i for i, _ in f] == [i for i, _ in c]
         np.testing.assert_allclose([s for _, s in f], [s for _, s in c],
                                    rtol=1e-5)
+
+
+def test_top_n_batch_twophase_matches_flat(monkeypatch):
+    """The streaming two-phase path (block maxima + approx block pick +
+    exact rescore + certificate) agrees with the flat exact kernel."""
+    from oryx_tpu.app.als import serving_model as sm
+    rng = np.random.default_rng(12)
+    ni, k = 4096, 8
+    model = ALSServingModel(k, implicit=True)
+    Y = rng.standard_normal((ni, k)).astype(np.float32)
+    model.Y.bulk_load([f"i{j}" for j in range(ni)], Y)
+    Q = rng.standard_normal((5, k)).astype(np.float32)
+    flat = model.top_n_batch(6, Q)
+    monkeypatch.setattr(sm, "_FLAT_SCORES_LIMIT", 1)
+    monkeypatch.setattr(sm, "_MAX_CHUNK_ROWS", 1024)
+    monkeypatch.setattr(sm, "_BLOCK_ROWS", 64)
+    monkeypatch.setattr(sm, "_BLOCK_KSEL", 8)
+    two = model.top_n_batch(6, Q)
+    assert model.twophase_fallbacks == 0
+    for f, c in zip(flat, two):
+        assert [i for i, _ in f] == [i for i, _ in c]
+        np.testing.assert_allclose([s for _, s in f], [s for _, s in c],
+                                   rtol=1e-5)
+    # LSH masks fuse into both phases
+    model2 = ALSServingModel(k, implicit=True, sample_rate=0.3)
+    model2.Y.bulk_load([f"i{j}" for j in range(ni)], Y)
+    lsh_two = model2.top_n_batch(6, Q)
+    monkeypatch.undo()
+    lsh_flat = model2.top_n_batch(6, Q)
+    for f, c in zip(lsh_flat, lsh_two):
+        assert [i for i, _ in f] == [i for i, _ in c]
+
+
+def test_top_n_batch_twophase_cert_fallback(monkeypatch):
+    """A failed exactness certificate triggers the exact-scan recompute
+    and still returns correct results."""
+    from oryx_tpu.app.als import serving_model as sm
+    rng = np.random.default_rng(13)
+    ni, k = 2048, 8
+    model = ALSServingModel(k, implicit=True)
+    model.Y.bulk_load([f"i{j}" for j in range(ni)],
+                      rng.standard_normal((ni, k)).astype(np.float32))
+    Q = rng.standard_normal((3, k)).astype(np.float32)
+    want = model.top_n_batch(5, Q)
+
+    real = sm._batch_top_n_twophase_kernel
+
+    def sabotaged(*args, **kw):
+        ts, ti, cert = real(*args, **kw)
+        return ts, ti, cert & False  # force every certificate to fail
+
+    monkeypatch.setattr(sm, "_FLAT_SCORES_LIMIT", 1)
+    monkeypatch.setattr(sm, "_MAX_CHUNK_ROWS", 512)
+    monkeypatch.setattr(sm, "_BLOCK_ROWS", 64)
+    monkeypatch.setattr(sm, "_BLOCK_KSEL", 8)
+    monkeypatch.setattr(sm, "_batch_top_n_twophase_kernel", sabotaged)
+    got = model.top_n_batch(5, Q)
+    assert model.twophase_fallbacks >= 1
+    for f, c in zip(want, got):
+        assert [i for i, _ in f] == [i for i, _ in c]

@@ -172,3 +172,87 @@ def test_http_recommend_goes_through_batcher():
         assert sum(layer.top_n_batcher.batch_sizes) == 40
     finally:
         layer.close()
+
+
+def test_pacing_coalesces_under_slow_device():
+    """When each dispatch is slow (big model), free dispatcher threads
+    must NOT shred the queue into minimal batches: pacing at the
+    measured service rate makes concurrent requests coalesce."""
+    import time as _time
+
+    class SlowModel:
+        def __init__(self, model):
+            self.model = model
+
+        def top_n_batch(self, how_many, vectors, exclude=None):
+            _time.sleep(0.05)  # 50 ms per dispatch, like a 5M-item scan
+            return self.model.top_n_batch(how_many, vectors, exclude)
+
+    model = _small_model(items=50, features=4)
+    slow = SlowModel(model)
+    batcher = TopNBatcher(pipeline=32)
+    try:
+        results = [None] * 80
+        def call(i):
+            results[i] = batcher.top_n(
+                slow, 3, np.asarray([1, 0, 0, 0], np.float32) * (i + 1))
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(80)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert all(r is not None and len(r) == 3 for r in results)
+        # without pacing, 32 idle dispatchers produce ~80 batches of ~1;
+        # with pacing the tail coalesces into service-interval drains
+        sizes = batcher.batch_sizes
+        assert sum(sizes) == 80
+        assert max(sizes) >= 4, sizes
+        assert len(sizes) <= 40, sizes
+    finally:
+        batcher.close()
+
+
+def test_pacing_relearns_after_hot_swap():
+    """The service-rate estimate must relearn DOWNWARD when a big model
+    is hot-swapped for a small one — otherwise pacing stays locked at
+    the old model's interval and serializes dispatches forever."""
+    import time as _time
+
+    class SerialDevice:
+        """Device-like: executions serialize behind one lock."""
+
+        def __init__(self, model):
+            self.model = model
+            self.exec_s = 0.06
+            self.lock = threading.Lock()
+
+        def top_n_batch(self, hm, v, e=None):
+            with self.lock:
+                _time.sleep(self.exec_s)
+            return self.model.top_n_batch(hm, v, e)
+
+    model = _small_model(items=50, features=4)
+    mm = SerialDevice(model)
+    batcher = TopNBatcher(pipeline=8)
+    try:
+        def load(seconds, workers=12):
+            stop = time.monotonic() + seconds
+            def w():
+                while time.monotonic() < stop:
+                    batcher.top_n(mm, 3, np.zeros(4, np.float32))
+            ts = [threading.Thread(target=w) for _ in range(workers)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+
+        load(2.0)
+        ewma_slow = batcher._exec_ewma
+        assert ewma_slow > 0.02, ewma_slow  # learned the service time
+        mm.exec_s = 0.001
+        load(1.2)
+        assert batcher._exec_ewma < ewma_slow / 3, \
+            (ewma_slow, batcher._exec_ewma)
+    finally:
+        batcher.close()
