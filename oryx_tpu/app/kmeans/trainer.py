@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import logging
 import math
+import time
 from functools import partial
 
 import jax
@@ -27,7 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...common.rand import RandomManager
-from .common import ClusterInfo, assign_points
+from .common import ClusterInfo
 
 _log = logging.getLogger(__name__)
 
@@ -41,7 +42,13 @@ _INIT_ROUNDS = 5  # k-means|| rounds (MLlib default: 2? uses 5 historically)
 
 @partial(jax.jit, static_argnames=("iterations",))
 def _lloyd(points, centers0, iterations: int):
-    """Run `iterations` Lloyd steps; returns (centers, cost)."""
+    """Run `iterations` Lloyd steps; returns (centers, cost, counts).
+
+    Fully device-resident: the caller fetches only (k, d) centers, a
+    scalar cost, and (k,) final-assignment counts.  When the chip sits
+    behind a network transport, data movement — not the distance
+    matmul — is what dominates a naive implementation (a single (n,)
+    assignment fetch at 5M points moves 20 MB per call)."""
     pp = jnp.sum(points * points, axis=1)
 
     def step(centers, _):
@@ -58,10 +65,19 @@ def _lloyd(points, centers0, iterations: int):
             (counts > 0)[:, None], sums / jnp.maximum(counts, 1.0)[:, None],
             centers)  # empty cluster keeps its previous center
         cost = jnp.sum(jnp.maximum(jnp.min(d, axis=1), 0.0))
-        return new_centers, cost
+        return new_centers, (cost, counts)
 
-    centers, costs = jax.lax.scan(step, centers0, None, length=iterations)
-    return centers, costs[-1]
+    centers, (costs, counts) = jax.lax.scan(step, centers0, None,
+                                            length=iterations)
+    # counts of the LAST step describe the assignment to the second-to-
+    # last centers; one more assignment pass reports the final state
+    d = (pp[:, None]
+         - 2.0 * jnp.matmul(points, centers.T,
+                            preferred_element_type=jnp.float32)
+         + jnp.sum(centers * centers, axis=1)[None, :])
+    onehot = jax.nn.one_hot(jnp.argmin(d, axis=1), centers.shape[0],
+                            dtype=jnp.float32)
+    return centers, costs[-1], jnp.sum(onehot, axis=0)
 
 
 def _kmeans_pp_weighted(cands: np.ndarray, weights: np.ndarray, k: int,
@@ -82,62 +98,126 @@ def _kmeans_pp_weighted(cands: np.ndarray, weights: np.ndarray, k: int,
     return np.stack(centers).astype(np.float32)
 
 
-def _assign_padded(points: np.ndarray,
-                   cands: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
-    """assign_points with the candidate set padded to a power of two:
-    the candidate count changes every k-means|| round, and each distinct
-    shape would otherwise compile a fresh assignment kernel.  Padding
-    rows DUPLICATE the first candidate — argmin ties resolve to the
-    lowest index, so a padding row can never be selected and no sentinel
-    magnitude can overflow the float32 distance kernel."""
+def _pad_cands(cands: np.ndarray) -> np.ndarray:
+    """Pad a candidate set to a power of two so the per-round kernels
+    see a handful of static shapes.  Padding rows DUPLICATE the first
+    candidate — argmin ties resolve to the lowest index, so a padding
+    row can never be selected and no sentinel magnitude can overflow
+    the float32 distance kernel."""
     m = len(cands)
     pad = (1 << max(0, (m - 1).bit_length())) - m
     if pad:
         cands = np.concatenate(
             [cands, np.broadcast_to(cands[0], (pad, cands.shape[1]))])
-    return assign_points(points, cands)
+    return cands
 
 
-def _init_parallel(points: np.ndarray, k: int,
+@jax.jit
+def _d2_phi_kernel(points, cands):
+    """Squared distance of every point to its nearest candidate, plus
+    the total (the k-means|| potential phi) — device-resident, nothing
+    big crosses the transport."""
+    d = (jnp.sum(points * points, axis=1, keepdims=True)
+         - 2.0 * jnp.matmul(points, cands.T,
+                            preferred_element_type=jnp.float32)
+         + jnp.sum(cands * cands, axis=1)[None, :])
+    d2 = jnp.maximum(jnp.min(d, axis=1), 0.0)
+    return d2, jnp.sum(d2)
+
+
+@jax.jit
+def _bernoulli_packed_kernel(key, d2, phi, ell):
+    """k-means|| oversampling draw, on device: mask_i ~ Bernoulli(
+    min(1, ell * d2_i / phi)), returned bit-packed so a 5M-point draw
+    fetches ~600 KB instead of a 20 MB distance vector."""
+    probs = jnp.minimum(1.0, ell * d2 / jnp.maximum(phi, 1e-30))
+    mask = jax.random.uniform(key, d2.shape) < probs
+    return jnp.packbits(mask)
+
+
+@jax.jit
+def _count_assign_kernel(points, cands):
+    """How many points each candidate attracts (weights for the final
+    weighted k-means++) — a one-hot matmul reduce, (m,) fetched."""
+    d = (jnp.sum(points * points, axis=1, keepdims=True)
+         - 2.0 * jnp.matmul(points, cands.T,
+                            preferred_element_type=jnp.float32)
+         + jnp.sum(cands * cands, axis=1)[None, :])
+    onehot = jax.nn.one_hot(jnp.argmin(d, axis=1), cands.shape[0],
+                            dtype=jnp.float32)
+    return jnp.sum(onehot, axis=0)
+
+
+def _gather_rows(dev_points: jax.Array, rows: np.ndarray) -> np.ndarray:
+    """Fetch selected rows with the row count padded to a power of two
+    (duplicating row 0) so the Bernoulli draw's random candidate count
+    doesn't compile a fresh XLA gather every k-means|| round."""
+    m = len(rows)
+    pad = (1 << max(0, (m - 1).bit_length())) - m
+    padded = np.concatenate([rows, np.zeros(pad, rows.dtype)]) if pad \
+        else rows
+    out = np.asarray(jax.device_get(dev_points[jnp.asarray(padded)]),
+                     dtype=np.float64)
+    return out[:m]
+
+
+def _init_parallel(dev_points: jax.Array, k: int,
                    rng: np.random.Generator) -> np.ndarray:
     """k-means|| (Bahmani et al.): oversample ~2k candidates per round
     proportionally to current cost, then weighted k-means++ down to k.
-    The per-round cost/distance evaluations are device kernels."""
-    n = len(points)
-    first = points[rng.integers(n)][None, :]
-    cands = first
-    _, dist = _assign_padded(points, cands)
-    d2 = dist.astype(np.float64) ** 2
+    All per-point state stays on device; per round the host fetches one
+    bit-packed Bernoulli mask and the few chosen rows."""
+    n = int(dev_points.shape[0])
+    first = int(rng.integers(n))
+    cands = np.asarray(jax.device_get(dev_points[first]),
+                       dtype=np.float64)[None, :]
     ell = 2.0 * k
     for _ in range(_INIT_ROUNDS):
-        phi = d2.sum()
-        if phi <= 0:
+        padded = jnp.asarray(_pad_cands(cands.astype(np.float32)))
+        d2, phi = _d2_phi_kernel(dev_points, padded)
+        if float(jax.device_get(phi)) <= 0:
             break
-        probs = np.minimum(1.0, ell * d2 / phi)
-        chosen = points[rng.random(n) < probs]
-        if len(chosen) == 0:
+        key = jax.random.PRNGKey(int(rng.integers(2**31)))
+        packed = jax.device_get(
+            _bernoulli_packed_kernel(key, d2, phi, ell))
+        mask = np.unpackbits(packed, count=n).astype(bool)
+        idx = np.nonzero(mask)[0]
+        if len(idx) == 0:
             continue
-        cands = np.concatenate([cands, chosen])
-        _, dist = _assign_padded(points, cands)
-        d2 = dist.astype(np.float64) ** 2
+        cands = np.concatenate([cands, _gather_rows(dev_points, idx)])
     if len(cands) <= k:
         # not enough candidates; fill with random points
-        extra = points[rng.choice(n, size=k - len(cands) + 1, replace=n < k)]
-        cands = np.concatenate([cands, extra])
+        extra_rows = rng.choice(n, size=k - len(cands) + 1, replace=n < k)
+        cands = np.concatenate([cands,
+                                _gather_rows(dev_points, extra_rows)])
     # weight candidates by how many points they attract
-    idx, _ = _assign_padded(points, cands)
-    weights = np.bincount(idx, minlength=len(cands)).astype(np.float64)
+    weights = np.asarray(jax.device_get(_count_assign_kernel(
+        dev_points, jnp.asarray(_pad_cands(cands.astype(np.float32))))),
+        dtype=np.float64)[:len(cands)]
     weights = np.maximum(weights, 1e-12)
-    return _kmeans_pp_weighted(cands.astype(np.float64), weights, k, rng)
+    return _kmeans_pp_weighted(cands, weights, k, rng)
 
 
-def train_kmeans(points: np.ndarray, k: int, iterations: int,
+def train_kmeans(points: np.ndarray | jax.Array, k: int, iterations: int,
                  runs: int = 1, initialization: str = K_MEANS_PARALLEL,
-                 seed: int | None = None) -> list[ClusterInfo]:
+                 seed: int | None = None,
+                 timings: dict | None = None) -> list[ClusterInfo]:
     """Cluster `points` (n, d); returns k ClusterInfo with counts from
-    the final assignment."""
-    points = np.asarray(points, dtype=np.float32)
-    n = len(points)
+    the final assignment.
+
+    ``points`` may be a device array, in which case nothing big crosses
+    the host<->device transport at all: the whole train — init rounds,
+    Lloyd scan, final counts — fetches a few KB of centers/counts/cost.
+    A numpy input is uploaded once and reused across runs.
+
+    ``timings``, if given, receives ``init_s`` / ``lloyd_s`` totals so
+    benchmarks can report per-Lloyd-iteration cost separately from
+    initialization."""
+    if isinstance(points, jax.Array):
+        dev_points = points
+    else:
+        dev_points = jnp.asarray(np.asarray(points, dtype=np.float32))
+    n = int(dev_points.shape[0])
     if k < 2:
         raise ValueError("k must be > 1")
     if n < k:
@@ -145,23 +225,33 @@ def train_kmeans(points: np.ndarray, k: int, iterations: int,
     rng = np.random.default_rng(
         RandomManager.random_seed() if seed is None else seed)
 
-    dev_points = jnp.asarray(points)
-    best_centers, best_cost = None, math.inf
+    best = None
+    best_cost = math.inf
+    init_s = lloyd_s = 0.0
     for run in range(max(1, runs)):
+        t0 = time.perf_counter()
         if initialization == RANDOM:
-            centers0 = points[rng.choice(n, size=k, replace=False)]
+            rows = rng.choice(n, size=k, replace=False)
+            centers0 = np.asarray(
+                jax.device_get(dev_points[jnp.asarray(rows)]))
         elif initialization == K_MEANS_PARALLEL:
-            centers0 = _init_parallel(points, k, rng)
+            centers0 = _init_parallel(dev_points, k, rng)
         else:
             raise ValueError(
                 f"unknown initialization strategy: {initialization}")
-        centers, cost = jax.device_get(
-            _lloyd(dev_points, jnp.asarray(centers0), iterations))
+        t1 = time.perf_counter()
+        init_s += t1 - t0
+        centers, cost, counts = jax.device_get(
+            _lloyd(dev_points, jnp.asarray(centers0, dtype=jnp.float32),
+                   iterations))
+        lloyd_s += time.perf_counter() - t1
         _log.info("k-means run %d/%d cost %.4f", run + 1, runs, cost)
         if cost < best_cost:
-            best_centers, best_cost = centers, float(cost)
+            best, best_cost = (centers, counts), float(cost)
 
-    idx, _ = assign_points(points, best_centers)
-    counts = np.bincount(idx, minlength=k)
-    return [ClusterInfo(i, best_centers[i], max(1, int(counts[i])))
+    if timings is not None:
+        timings["init_s"] = init_s
+        timings["lloyd_s"] = lloyd_s
+    centers, counts = best
+    return [ClusterInfo(i, centers[i], max(1, int(counts[i])))
             for i in range(k)]

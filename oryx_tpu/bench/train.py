@@ -136,7 +136,7 @@ def run_training_bench(n_users: int = ML20M_USERS,
                        n_ratings: int = ML20M_RATINGS,
                        rank: int = 100,
                        iterations: int = 10,
-                       explicit_iterations: int = 5,
+                       explicit_iterations: int = 20,
                        lam: float = 0.1,
                        alpha: float = 1.0,
                        auc_max_users: int = 5_000,
@@ -202,23 +202,60 @@ def run_training_bench(n_users: int = ML20M_USERS,
         "auc_eval_s": round(auc_eval_s, 1),
     }
 
-    # ---- explicit run: held-out RMSE vs the injected noise floor
+    # ---- explicit run: held-out RMSE vs the injected noise floor,
+    # with the RMSE-vs-iteration CURVE recorded so "the solver is still
+    # descending" and "the solver has converged above the floor" are
+    # distinguishable claims (Evaluation.java:49-63 semantics)
     if run_explicit:
-        t0 = time.perf_counter()
-        exp_model, esweeps = timed_train(exp_vals, False,
-                                         explicit_iterations)
-        exp_total_s = time.perf_counter() - t0
         ok = warm
-        test_rmse = rmse(exp_model.X, exp_model.Y,
-                         users[ok], items[ok], exp_vals[ok])
+        # curve evals run on a SAMPLE between sweeps and their time is
+        # excluded from the epoch metric: epoch_s must keep measuring
+        # training alone (the north-star metric), comparable across
+        # rounds, while the curve proves convergence
+        ok_idx = np.nonzero(ok)[0]
+        if len(ok_idx) > 200_000:
+            ok_idx = rng.choice(ok_idx, 200_000, replace=False)
+        cu, ci, cv = users[ok_idx], items[ok_idx], exp_vals[ok_idx]
+        curve: list[float] = []
+        sweep_times: list[float] = []
+        last_exit = [None]
+
+        def on_iter_rmse(i, X, Y):
+            entry = time.perf_counter()
+            if last_exit[0] is not None:
+                sweep_times.append(entry - last_exit[0])
+            curve.append(round(rmse(X, Y, cu, ci, cv), 4))
+            last_exit[0] = time.perf_counter()
+
+        ratings = ParsedRatings(user_ids, item_ids, users[train_mask],
+                                items[train_mask], exp_vals[train_mask])
+        t0 = time.perf_counter()
+        last_exit[0] = t0
+        exp_model = train_als(ratings, rank, lam, alpha, False,
+                              explicit_iterations, seed=seed,
+                              on_iteration=on_iter_rmse)
+        exp_total_s = time.perf_counter() - t0
+        # final quality on the FULL warm held-out set
+        test_rmse = round(rmse(exp_model.X, exp_model.Y,
+                               users[ok], items[ok], exp_vals[ok]), 4)
+        # quality gate: converged (plateaued) near the floor — the
+        # planted sigma plus half-star quantization and clipping put the
+        # achievable floor somewhat above noise_sigma itself
+        plateaued = (len(curve) >= 3
+                     and abs(curve[-1] - curve[-3]) < 0.005)
+        assert test_rmse < 1.5 * noise_sigma and (
+            plateaued or test_rmse < 1.1 * noise_sigma), curve
         result.update({
             "explicit_iterations": explicit_iterations,
-            "explicit_epoch_s": round(float(np.mean(esweeps[1:]))
-                                      if len(esweeps) > 1
-                                      else float(esweeps[0]), 3),
+            "explicit_epoch_s": round(float(np.mean(sweep_times[1:]))
+                                      if len(sweep_times) > 1
+                                      else sweep_times[0], 3),
+            "explicit_first_epoch_s": round(sweep_times[0], 3),
             "explicit_total_s": round(exp_total_s, 1),
-            "explicit_test_rmse": round(test_rmse, 4),
+            "explicit_test_rmse": test_rmse,
+            "explicit_rmse_curve": curve,
             "explicit_noise_floor": noise_sigma,
+            "quality_gate": "rmse < 1.5*sigma and plateaued",
         })
     return result
 
@@ -230,7 +267,7 @@ def main() -> None:
     ap.add_argument("--ratings", type=int, default=ML20M_RATINGS)
     ap.add_argument("--rank", type=int, default=100)
     ap.add_argument("--iterations", type=int, default=10)
-    ap.add_argument("--explicit-iterations", type=int, default=5)
+    ap.add_argument("--explicit-iterations", type=int, default=20)
     ap.add_argument("--seed", type=int, default=7)
     ap.add_argument("--no-explicit", action="store_true")
     ap.add_argument("--out", help="write full JSON artifact here")

@@ -1,0 +1,340 @@
+"""A stateful in-process fake of the ``kafka-python`` client API.
+
+Why this exists: the real-Kafka binding (`oryx_tpu/kafka/client.py`)
+is written against kafka-python, but the hermetic test image has
+neither that library nor a broker to point it at, and nothing may be
+installed.  The unit tests in test_kafka_client.py inject per-test
+stubs, which proves call sequences but not SEMANTICS.  This module is
+the next-strongest evidence available in this environment: one
+broker-state machine — topics, partitions, append logs, consumer-group
+committed offsets, auto_offset_reset rules, poll batching, blocking
+polls — shared by every producer/consumer/admin client the binding
+creates, so the full broker contract suite (produce/replay, group
+resume, fill-in-latest, multi-partition drains) runs through the REAL
+client code against one consistent implementation of Kafka's visible
+behavior.  (The reference proves its broker code against an actual
+in-process Kafka, LocalKafkaBroker.java:35; a wire-protocol server
+would be pointless here with no real client library to speak to it.)
+
+Install with :func:`install` — it registers ``kafka``, ``kafka.admin``,
+``kafka.structs`` and ``kafka.errors`` modules in ``sys.modules`` only
+when the real library is absent.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import types
+import zlib
+from collections import namedtuple
+
+TopicPartition = namedtuple("TopicPartition", ["topic", "partition"])
+OffsetAndMetadata = namedtuple("OffsetAndMetadata", ["offset", "metadata"])
+ConsumerRecord = namedtuple(
+    "ConsumerRecord", ["topic", "partition", "offset", "key", "value"])
+RecordMetadata = namedtuple(
+    "RecordMetadata", ["topic", "partition", "offset"])
+
+MAX_POLL_RECORDS = 500
+
+
+class KafkaError(Exception):
+    pass
+
+
+class TopicAlreadyExistsError(KafkaError):
+    pass
+
+
+class UnknownTopicOrPartitionError(KafkaError):
+    pass
+
+
+class _Cluster:
+    """All broker-visible state for one bootstrap address."""
+
+    _registry: dict[str, "_Cluster"] = {}
+    _registry_lock = threading.Lock()
+
+    @classmethod
+    def get(cls, bootstrap) -> "_Cluster":
+        key = str(bootstrap)
+        with cls._registry_lock:
+            c = cls._registry.get(key)
+            if c is None:
+                c = cls._registry[key] = _Cluster()
+            return c
+
+    def __init__(self):
+        self.cond = threading.Condition()
+        # topic -> list of partition logs; log entry = (key, value) bytes
+        self.topics: dict[str, list[list[tuple[bytes | None,
+                                               bytes | None]]]] = {}
+        # (group, topic, partition) -> committed offset
+        self.offsets: dict[tuple[str, str, int], int] = {}
+        self._round_robin: dict[str, int] = {}
+
+    # -- broker operations ---------------------------------------------------
+
+    def create_topic(self, name: str, partitions: int) -> None:
+        with self.cond:
+            if name in self.topics:
+                raise TopicAlreadyExistsError(name)
+            self.topics[name] = [[] for _ in range(partitions)]
+
+    def delete_topic(self, name: str) -> None:
+        with self.cond:
+            if name not in self.topics:
+                raise UnknownTopicOrPartitionError(name)
+            del self.topics[name]
+            for k in [k for k in self.offsets if k[1] == name]:
+                del self.offsets[k]
+
+    def append(self, topic: str, key: bytes | None,
+               value: bytes | None) -> tuple[int, int]:
+        """(partition, offset); auto-creates a 1-partition topic like a
+        default broker (auto.create.topics.enable=true)."""
+        with self.cond:
+            logs = self.topics.get(topic)
+            if logs is None:
+                logs = self.topics[topic] = [[]]
+            n = len(logs)
+            if key is None:
+                p = self._round_robin.get(topic, 0) % n
+                self._round_robin[topic] = p + 1
+            else:
+                p = zlib.crc32(key) % n
+            logs[p].append((key, value))
+            self.cond.notify_all()
+            return p, len(logs[p]) - 1
+
+    def partitions(self, topic: str) -> set[int] | None:
+        with self.cond:
+            logs = self.topics.get(topic)
+            return None if logs is None else set(range(len(logs)))
+
+    def end_offset(self, topic: str, partition: int) -> int:
+        with self.cond:
+            logs = self.topics.get(topic)
+            if logs is None or partition >= len(logs):
+                return 0
+            return len(logs[partition])
+
+
+class _Future:
+    def __init__(self, meta: RecordMetadata):
+        self._meta = meta
+
+    def get(self, timeout=None) -> RecordMetadata:
+        return self._meta
+
+
+class KafkaProducer:
+    def __init__(self, bootstrap_servers=None, **_kw):
+        self._cluster = _Cluster.get(bootstrap_servers)
+        self._closed = False
+
+    def send(self, topic, value=None, key=None) -> _Future:
+        if self._closed:
+            raise KafkaError("producer is closed")
+        p, off = self._cluster.append(topic, key, value)
+        return _Future(RecordMetadata(topic, p, off))
+
+    def flush(self, timeout=None) -> None:
+        pass  # appends are synchronous in the fake
+
+    def close(self, timeout=None) -> None:
+        self._closed = True
+
+
+class KafkaConsumer:
+    def __init__(self, bootstrap_servers=None, group_id=None,
+                 enable_auto_commit=False, auto_offset_reset="latest",
+                 **_kw):
+        self._cluster = _Cluster.get(bootstrap_servers)
+        self._group = group_id
+        self._reset = auto_offset_reset
+        self._assigned: list[TopicPartition] = []
+        self._subscribed: list[str] = []
+        self._positions: dict[TopicPartition, int] = {}
+        self._closed = False
+
+    # -- metadata ------------------------------------------------------------
+
+    def partitions_for_topic(self, topic):
+        return self._cluster.partitions(topic)
+
+    def end_offsets(self, tps):
+        return {tp: self._cluster.end_offset(tp.topic, tp.partition)
+                for tp in tps}
+
+    # -- assignment ----------------------------------------------------------
+
+    def assign(self, tps) -> None:
+        self._subscribed = []
+        self._assigned = list(tps)
+        self._positions = {tp: p for tp, p in self._positions.items()
+                           if tp in self._assigned}
+
+    def subscribe(self, topics) -> None:
+        """Single-member group: this consumer gets every partition (a
+        real group with one member resolves to the same assignment)."""
+        self._subscribed = list(topics)
+        self._refresh_subscription()
+
+    def _refresh_subscription(self) -> None:
+        if not self._subscribed:
+            return
+        assigned = []
+        for t in self._subscribed:
+            parts = self._cluster.partitions(t)
+            for p in sorted(parts or ()):
+                assigned.append(TopicPartition(t, p))
+        self._assigned = assigned
+
+    def unsubscribe(self) -> None:
+        self._subscribed = []
+        self._assigned = []
+        self._positions = {}
+
+    def seek(self, tp, offset) -> None:
+        self._positions[tp] = offset
+
+    def position(self, tp) -> int:
+        if tp not in self._positions:
+            self._positions[tp] = self._initial_position(tp)
+        return self._positions[tp]
+
+    def _initial_position(self, tp) -> int:
+        if self._group is not None:
+            committed = self._cluster.offsets.get(
+                (self._group, tp.topic, tp.partition))
+            if committed is not None:
+                return committed
+        if self._reset == "earliest":
+            return 0
+        return self._cluster.end_offset(tp.topic, tp.partition)
+
+    # -- consumption ---------------------------------------------------------
+
+    def poll(self, timeout_ms=0, max_records=None):
+        if self._closed:
+            raise KafkaError("consumer is closed")
+        limit = max_records or MAX_POLL_RECORDS
+        deadline = time.monotonic() + timeout_ms / 1000.0
+        while True:
+            # a subscription sees partitions/topics created after it
+            # (real consumers refresh metadata periodically)
+            self._refresh_subscription()
+            out: dict[TopicPartition, list[ConsumerRecord]] = {}
+            total = 0
+            with self._cluster.cond:
+                for tp in self._assigned:
+                    pos = self.position(tp)
+                    end = self._cluster.end_offset(tp.topic, tp.partition)
+                    take = min(end - pos, limit - total)
+                    if take <= 0:
+                        continue
+                    log = self._cluster.topics[tp.topic][tp.partition]
+                    recs = [ConsumerRecord(tp.topic, tp.partition,
+                                           pos + i, *log[pos + i])
+                            for i in range(take)]
+                    self._positions[tp] = pos + take
+                    out[tp] = recs
+                    total += take
+                if out or time.monotonic() >= deadline:
+                    return out
+                # block until new data or the poll timeout, like a real
+                # long poll
+                self._cluster.cond.wait(
+                    max(0.0, deadline - time.monotonic()))
+
+    # -- offsets -------------------------------------------------------------
+
+    def committed(self, tp):
+        if self._group is None:
+            return None
+        return self._cluster.offsets.get(
+            (self._group, tp.topic, tp.partition))
+
+    def commit(self, offsets=None) -> None:
+        if self._group is None:
+            raise KafkaError("commit requires a group id")
+        if offsets is None:
+            offsets = {tp: OffsetAndMetadata(pos, None)
+                       for tp, pos in self._positions.items()}
+        with self._cluster.cond:
+            for tp, om in offsets.items():
+                off = om.offset if hasattr(om, "offset") else int(om)
+                self._cluster.offsets[
+                    (self._group, tp.topic, tp.partition)] = off
+
+    def close(self, *a, **kw) -> None:
+        self._closed = True
+        self.unsubscribe()
+
+
+class NewTopic:
+    def __init__(self, name, num_partitions=1, replication_factor=1):
+        self.name = name
+        self.num_partitions = num_partitions
+        self.replication_factor = replication_factor
+
+
+class KafkaAdminClient:
+    def __init__(self, bootstrap_servers=None, **_kw):
+        self._cluster = _Cluster.get(bootstrap_servers)
+
+    def list_topics(self):
+        with self._cluster.cond:
+            return list(self._cluster.topics)
+
+    def create_topics(self, new_topics) -> None:
+        for nt in new_topics:
+            self._cluster.create_topic(nt.name, nt.num_partitions)
+
+    def delete_topics(self, topics) -> None:
+        for t in topics:
+            self._cluster.delete_topic(t)
+
+    def close(self) -> None:
+        pass
+
+
+def install() -> None:
+    """Register the fake as ``kafka``/``kafka.admin``/``kafka.structs``/
+    ``kafka.errors`` unless the real kafka-python is importable."""
+    if "kafka" in sys.modules and not getattr(
+            sys.modules["kafka"], "_ORYX_FAKE", False):
+        return  # a real (or other) kafka module is already loaded
+    try:
+        import importlib.util
+        if importlib.util.find_spec("kafka") is not None \
+                and "kafka" not in sys.modules:
+            return  # real library present on disk; let it win
+    except (ImportError, ValueError):
+        pass
+    root = types.ModuleType("kafka")
+    root._ORYX_FAKE = True
+    root.KafkaConsumer = KafkaConsumer
+    root.KafkaProducer = KafkaProducer
+    root.TopicPartition = TopicPartition
+    admin = types.ModuleType("kafka.admin")
+    admin.KafkaAdminClient = KafkaAdminClient
+    admin.NewTopic = NewTopic
+    structs = types.ModuleType("kafka.structs")
+    structs.OffsetAndMetadata = OffsetAndMetadata
+    structs.TopicPartition = TopicPartition
+    errors = types.ModuleType("kafka.errors")
+    errors.KafkaError = KafkaError
+    errors.TopicAlreadyExistsError = TopicAlreadyExistsError
+    errors.UnknownTopicOrPartitionError = UnknownTopicOrPartitionError
+    root.admin = admin
+    root.structs = structs
+    root.errors = errors
+    sys.modules["kafka"] = root
+    sys.modules["kafka.admin"] = admin
+    sys.modules["kafka.structs"] = structs
+    sys.modules["kafka.errors"] = errors

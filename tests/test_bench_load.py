@@ -64,11 +64,13 @@ def test_bench_apps_small_scale():
     from oryx_tpu.bench.apps import bench_kmeans, bench_rdf
 
     km = bench_kmeans(n_points=2000, dims=4, k=3, iterations=2)
-    assert km["iteration_s"] > 0 and km["points"] == 2000
+    # toy-scale Lloyd rounds to 0.000s at 3-decimal precision; total
+    # includes init and is always measurable
+    assert km["total_s"] > 0 and km["points"] == 2000
     rdf = bench_rdf(n_examples=1500, n_predictors=4, num_trees=2,
-                    max_depth=3)
+                    max_depth=3, min_accuracy=0.6)
     assert rdf["warm_total_s"] > 0
-    assert 0.5 < rdf["train_accuracy"] <= 1.0
+    assert 0.6 <= rdf["heldout_accuracy"] <= 1.0
 
 
 def test_grid_bench_toy_scale(monkeypatch):

@@ -255,6 +255,11 @@ def _kafka_test_broker():
                                        kafka_client_available)
     if not kafka_client_available():
         pytest.skip("kafka-python not installed")
+    import kafka
+    if getattr(kafka, "_ORYX_FAKE", False):
+        # the fakekafka leg may have installed the in-process fake
+        # earlier in the session; this leg is for a REAL broker only
+        pytest.skip("kafka-python not installed (in-process fake active)")
     bootstrap = os.environ.get("KAFKA_TEST_BOOTSTRAP", "localhost:9092")
     # first entry of a possibly multi-host bootstrap list; a malformed
     # value skips rather than erroring the suite
@@ -267,13 +272,32 @@ def _kafka_test_broker():
     return get_kafka_broker(bootstrap)
 
 
-@pytest.fixture(params=["inproc", "kafka"])
+def _fake_kafka_broker():
+    """The real-Kafka binding (kafka/client.py) running against the
+    stateful kafka-python fake (tests/fake_kafka.py): the full client
+    code path — metadata, range drains, batched commits, group resume —
+    exercised against one consistent broker-state machine.  The real
+    library cannot be installed in this image; see fake_kafka's
+    docstring for why this is the strongest evidence available."""
+    from tests import fake_kafka
+    fake_kafka.install()
+    import kafka
+    if not getattr(kafka, "_ORYX_FAKE", False):
+        pytest.skip("real kafka-python importable; the fake-binding leg "
+                    "would bootstrap real sockets against a bogus host")
+    from oryx_tpu.kafka.client import KafkaBroker
+    return KafkaBroker("fake-" + str(time.monotonic_ns()))
+
+
+@pytest.fixture(params=["inproc", "fakekafka", "kafka"])
 def any_broker(request):
     if request.param == "kafka":
         # real broker: group join/rebalance takes seconds on a default
         # broker config (group.initial.rebalance.delay.ms=3000), so the
         # consume idle window must comfortably exceed it
         yield _kafka_test_broker(), 10.0
+    elif request.param == "fakekafka":
+        yield _fake_kafka_broker(), 0.5
     else:
         yield (InProcBroker("contract-" + str(time.monotonic_ns())), 0.2)
 
