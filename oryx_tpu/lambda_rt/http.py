@@ -329,6 +329,7 @@ def make_server(app: HttpApp, port: int,
         wbufsize = -1  # buffered response writes, one flush per request
 
         def setup(self):
+            self._alpn = None
             if ssl_context is not None:
                 # handshake here, in this connection's worker thread,
                 # with a bound so a silent client can't hold the thread
@@ -336,10 +337,21 @@ def make_server(app: HttpApp, port: int,
                 self.request.settimeout(30)
                 self.request.do_handshake()
                 self.request.settimeout(None)
+                self._alpn = self.request.selected_alpn_protocol()
             super().setup()
 
         def handle(self):
             try:
+                if self._alpn == "h2":
+                    # TLS ALPN chose HTTP/2 (reference connector parity:
+                    # ServingLayer.java:202-255 adds Http2Protocol)
+                    from . import http2
+                    try:
+                        http2.serve_connection(app, self.rfile,
+                                               self.wfile)
+                    except http2.H2Error:
+                        pass  # bad preface / protocol abuse: just close
+                    return
                 while self._handle_one():
                     pass
             except (ConnectionError, TimeoutError, OSError):
@@ -351,6 +363,16 @@ def make_server(app: HttpApp, port: int,
                 line = self.rfile.readline(65537)
             if not line:
                 return False  # clean keep-alive close
+            if line == b"PRI * HTTP/2.0\r\n":
+                # cleartext h2 with prior knowledge (curl
+                # --http2-prior-knowledge, gRPC-style clients)
+                rest = self.rfile.read(8)
+                if rest != b"\r\nSM\r\n\r\n":
+                    return False
+                from . import http2
+                http2.serve_connection(app, self.rfile, self.wfile,
+                                       preface_consumed=True)
+                return False
             parts = line.split()
             if len(parts) != 3 or not parts[2].startswith(b"HTTP/"):
                 self.wfile.write(b"HTTP/1.1 400 Bad Request\r\n"
@@ -414,6 +436,11 @@ def make_server(app: HttpApp, port: int,
 
     server = _Server(("0.0.0.0", port), _Handler)
     if ssl_context is not None:
+        try:
+            # negotiate h2 when the client offers it; http/1.1 otherwise
+            ssl_context.set_alpn_protocols(["h2", "http/1.1"])
+        except NotImplementedError:  # pragma: no cover - exotic builds
+            pass
         server.socket = ssl_context.wrap_socket(
             server.socket, server_side=True,
             do_handshake_on_connect=False)

@@ -1,0 +1,309 @@
+"""HTTP/2 connector tests: HPACK against the RFC's own vectors, and the
+full h2 stack against curl's nghttp2 — a real, independent client
+(reference connector parity: ServingLayer.java:202-255)."""
+
+import json
+import shutil
+import socket
+import subprocess
+import threading
+
+import numpy as np
+import pytest
+
+from oryx_tpu.lambda_rt.hpack import (HpackDecoder, HpackEncoder,
+                                      huffman_decode, huffman_encode)
+
+# -- HPACK: RFC 7541 Appendix C ground truth ---------------------------------
+
+RFC_HUFFMAN_VECTORS = [
+    ("f1e3c2e5f23a6ba0ab90f4ff", b"www.example.com"),
+    ("a8eb10649cbf", b"no-cache"),
+    ("25a849e95ba97d7f", b"custom-key"),
+    ("25a849e95bb8e8b4bf", b"custom-value"),
+    ("6402", b"302"),
+    ("aec3771a4b", b"private"),
+    ("d07abe941054d444a8200595040b8166e082a62d1bff",
+     b"Mon, 21 Oct 2013 20:13:21 GMT"),
+    ("9d29ad171863c78f0b97c8e9ae82ae43d3", b"https://www.example.com"),
+]
+
+
+def test_huffman_rfc_vectors_decode_and_encode():
+    for hx, want in RFC_HUFFMAN_VECTORS:
+        assert huffman_decode(bytes.fromhex(hx)) == want
+        assert huffman_encode(want).hex() == hx
+
+
+def test_huffman_round_trip_fuzz():
+    rng = np.random.default_rng(2)
+    for _ in range(200):
+        raw = bytes(rng.integers(0, 256, rng.integers(0, 60),
+                                 dtype=np.uint8))
+        assert huffman_decode(huffman_encode(raw)) == raw
+
+
+def test_hpack_rfc_c3_request_sequence_without_huffman():
+    """RFC 7541 C.3: three requests on one connection, dynamic table
+    evolving across them."""
+    d = HpackDecoder()
+    first = bytes.fromhex("828684410f7777772e6578616d706c652e636f6d")
+    assert d.decode(first) == [(":method", "GET"), (":scheme", "http"),
+                               (":path", "/"),
+                               (":authority", "www.example.com")]
+    second = bytes.fromhex("828684be58086e6f2d6361636865")
+    assert d.decode(second) == [(":method", "GET"), (":scheme", "http"),
+                                (":path", "/"),
+                                (":authority", "www.example.com"),
+                                ("cache-control", "no-cache")]
+    third = bytes.fromhex(
+        "828785bf400a637573746f6d2d6b65790c637573746f6d2d76616c7565")
+    assert d.decode(third) == [(":method", "GET"), (":scheme", "https"),
+                               (":path", "/index.html"),
+                               (":authority", "www.example.com"),
+                               ("custom-key", "custom-value")]
+
+
+def test_hpack_rfc_c4_request_sequence_with_huffman():
+    d = HpackDecoder()
+    first = bytes.fromhex("828684418cf1e3c2e5f23a6ba0ab90f4ff")
+    assert d.decode(first)[-1] == (":authority", "www.example.com")
+    second = bytes.fromhex("828684be5886a8eb10649cbf")
+    assert d.decode(second)[-1] == ("cache-control", "no-cache")
+
+
+def test_hpack_encoder_is_decodable_and_uses_static_indexing():
+    enc, dec = HpackEncoder(), HpackDecoder()
+    headers = [(":status", "200"), ("content-type", "application/json"),
+               ("content-length", "42"), ("x-custom", "v1")]
+    block = enc.encode(headers)
+    assert dec.decode(block) == headers
+    # ":status 200" must be the single static-index byte 0x88
+    assert block[0] == 0x88
+
+
+# -- live h2 against curl/nghttp2 --------------------------------------------
+
+def _serving_app():
+    from oryx_tpu.app.als.serving_model import ALSServingModel
+    from oryx_tpu.bench.load import StaticModelManager
+    from oryx_tpu.lambda_rt.http import HttpApp, make_server
+    from oryx_tpu.serving import als as als_resources
+    from oryx_tpu.serving import framework as framework_resources
+    from oryx_tpu.serving.batcher import TopNBatcher
+
+    rng = np.random.default_rng(0)
+    model = ALSServingModel(features=6, implicit=True)
+    model.Y.bulk_load([f"i{j}" for j in range(80)],
+                      rng.standard_normal((80, 6)).astype(np.float32))
+    model.X.bulk_load([f"u{j}" for j in range(10)],
+                      rng.standard_normal((10, 6)).astype(np.float32))
+    import time as _time
+
+    from oryx_tpu.kafka.inproc import InProcTopicProducer
+
+    StaticModelManager.model = model
+    batcher = TopNBatcher(pipeline=2)
+    producer = InProcTopicProducer(
+        f"memory://h2test-{_time.monotonic_ns()}", "In")
+    app = HttpApp(
+        framework_resources.ROUTES + als_resources.ROUTES,
+        context={"model_manager": StaticModelManager(),
+                 "input_producer": producer, "config": None,
+                 "min_model_load_fraction": 0.0,
+                 "top_n_batcher": batcher},
+        read_only=False)
+    return app, batcher, make_server
+
+
+@pytest.fixture
+def h2_server():
+    app, batcher, make_server = _serving_app()
+    server = make_server(app, 0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    yield port
+    server.shutdown()
+    batcher.close()
+
+
+def _curl(args: list[str], timeout=20) -> subprocess.CompletedProcess:
+    if shutil.which("curl") is None:
+        pytest.skip("curl not available")
+    return subprocess.run(["curl", "-sS", *args], capture_output=True,
+                          text=True, timeout=timeout)
+
+
+def test_curl_h2c_prior_knowledge_get(h2_server):
+    r = _curl(["--http2-prior-knowledge", "-w", "\n%{http_version}",
+               f"http://127.0.0.1:{h2_server}/recommend/u0?howMany=3"])
+    assert r.returncode == 0, r.stderr
+    body, version = r.stdout.rsplit("\n", 1)
+    assert version == "2"
+    recs = json.loads(body)
+    assert len(recs) == 3 and all("id" in x for x in recs)
+
+
+def test_curl_h2c_matches_h1_response(h2_server):
+    h2 = _curl(["--http2-prior-knowledge",
+                f"http://127.0.0.1:{h2_server}/recommend/u1?howMany=5"])
+    h1 = _curl(["--http1.1",
+                f"http://127.0.0.1:{h2_server}/recommend/u1?howMany=5"])
+    assert h2.returncode == 0 and h1.returncode == 0
+    assert json.loads(h2.stdout) == json.loads(h1.stdout)
+
+
+def test_curl_h2c_post_body_and_multiple_requests(h2_server):
+    # POST /pref with a body (DATA frames), then a GET on a second
+    # connection-reused stream; -d forces content-length handling
+    r = _curl(["--http2-prior-knowledge", "-X", "POST",
+               "-d", "2.5",
+               "-o", "/dev/null", "-w", "%{http_code}",
+               f"http://127.0.0.1:{h2_server}/pref/u0/i3"])
+    # /pref returns 204 No Content on success (reference Preference.java)
+    assert r.returncode == 0 and r.stdout == "204", (r.stdout, r.stderr)
+
+
+def test_multiple_streams_on_one_connection(h2_server):
+    """Two sequential streams multiplex over one h2c connection.  Driven
+    with a raw-socket client built on our HpackEncoder because curl
+    7.88's h2c connection REUSE is broken client-side (its h2 filter
+    rewrite; fixed in curl 8.x — reuse over TLS works, see the ALPN
+    test); the frames this asserts on were independently validated
+    against curl for single transfers."""
+    import struct
+
+    from oryx_tpu.lambda_rt import http2 as h2mod
+
+    enc = HpackEncoder()
+    with socket.create_connection(("127.0.0.1", h2_server),
+                                  timeout=10) as s:
+        s.sendall(h2mod.PREFACE)
+        s.sendall(b"\x00\x00\x00\x04\x00\x00\x00\x00\x00")  # SETTINGS
+        for sid, path in ((1, "/ready"), (3, "/allItemIDs")):
+            block = enc.encode([(":method", "GET"), (":path", path),
+                                (":scheme", "http"), (":authority", "a")])
+            s.sendall(len(block).to_bytes(3, "big") + bytes([1, 0x5])
+                      + sid.to_bytes(4, "big") + block)
+        got: dict[int, dict] = {}
+        body = bytearray()
+        r = s.makefile("rb")
+        while not (got.get(1, {}).get("done")
+                   and got.get(3, {}).get("done")):
+            head = r.read(9)
+            length = int.from_bytes(head[:3], "big")
+            ftype, flags = head[3], head[4]
+            sid = int.from_bytes(head[5:9], "big") & 0x7FFFFFFF
+            payload = r.read(length)
+            if ftype == 1:  # HEADERS
+                got.setdefault(sid, {})["status"] = payload[0]
+                if flags & 0x1:
+                    got[sid]["done"] = True
+            elif ftype == 0:  # DATA
+                body += payload
+                if flags & 0x1:
+                    got[sid]["done"] = True
+            elif ftype == 4 and not flags & 0x1:
+                s.sendall(b"\x00\x00\x00\x04\x01\x00\x00\x00\x00")  # ack
+        assert got[1]["status"] == 0x89  # :status 204 (static index 9)
+        assert json.loads(bytes(body))  # allItemIDs payload on stream 3
+
+
+def test_curl_h2_over_tls_alpn(tmp_path):
+    """Full ALPN negotiation: curl --http2 over TLS must land on h2."""
+    try:
+        from cryptography import x509
+        from cryptography.hazmat.primitives import hashes, serialization
+        from cryptography.hazmat.primitives.asymmetric import rsa
+        from cryptography.x509.oid import NameOID
+    except ImportError:
+        pytest.skip("cryptography unavailable")
+    import datetime
+    import ssl
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, "localhost")])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (x509.CertificateBuilder().subject_name(name).issuer_name(name)
+            .public_key(key.public_key()).serial_number(1)
+            .not_valid_before(now - datetime.timedelta(days=1))
+            .not_valid_after(now + datetime.timedelta(days=1))
+            .sign(key, hashes.SHA256()))
+    pem = tmp_path / "s.pem"
+    pem.write_bytes(
+        cert.public_bytes(serialization.Encoding.PEM)
+        + key.private_bytes(serialization.Encoding.PEM,
+                            serialization.PrivateFormat.TraditionalOpenSSL,
+                            serialization.NoEncryption()))
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(str(pem))
+    app, batcher, make_server = _serving_app()
+    server = make_server(app, 0, ssl_context=ctx)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        r = _curl(["--http2", "-k", "-w", "\n%{http_version}",
+                   f"https://127.0.0.1:{port}/recommend/u2?howMany=2"])
+        assert r.returncode == 0, r.stderr
+        body, version = r.stdout.rsplit("\n", 1)
+        assert version == "2"
+        assert len(json.loads(body)) == 2
+        # connection REUSE with a real client: two URLs share one h2
+        # session over TLS (exercises a second stream's HPACK state)
+        r = _curl(["--http2", "-k",
+                   f"https://127.0.0.1:{port}/allItemIDs",
+                   f"https://127.0.0.1:{port}/allUserIDs"])
+        assert r.returncode == 0, r.stderr
+        assert r.stdout.count("[") == 2  # both JSON arrays arrived
+    finally:
+        server.shutdown()
+        batcher.close()
+
+
+def test_h2c_sniff_rejects_garbage_preface(h2_server):
+    with socket.create_connection(("127.0.0.1", h2_server),
+                                  timeout=10) as s:
+        s.sendall(b"PRI * HTTP/2.0\r\nXXGARBAGE")
+        assert s.makefile("rb").read() == b""  # clean close, no crash
+
+
+def test_huffman_rejects_invalid_padding():
+    # '0' is the 5-bit code 00000; three trailing 0-bits are NOT the
+    # EOS prefix and must be rejected (RFC 7541 §5.2)
+    from oryx_tpu.lambda_rt.hpack import HpackError
+    assert huffman_decode(b"\x07") == b"0"  # correct all-ones padding
+    with pytest.raises(HpackError):
+        huffman_decode(b"\x00")
+
+
+def test_h2_request_trailers_are_tolerated(h2_server):
+    """HEADERS + DATA + trailing HEADERS(END_STREAM) is a legal request
+    shape (RFC 9113 §8.1); trailers must not clobber :method/:path."""
+    from oryx_tpu.lambda_rt import http2 as h2mod
+
+    enc = HpackEncoder()
+    with socket.create_connection(("127.0.0.1", h2_server),
+                                  timeout=10) as s:
+        s.sendall(h2mod.PREFACE)
+        s.sendall(b"\x00\x00\x00\x04\x00\x00\x00\x00\x00")
+        block = enc.encode([(":method", "POST"), (":path", "/pref/u0/i5"),
+                            (":scheme", "http"), (":authority", "a")])
+        s.sendall(len(block).to_bytes(3, "big") + bytes([1, 0x4])
+                  + (1).to_bytes(4, "big") + block)          # no END_STREAM
+        s.sendall((3).to_bytes(3, "big") + bytes([0, 0x0])
+                  + (1).to_bytes(4, "big") + b"4.5")         # DATA
+        trailer = enc.encode([("x-checksum", "abc")])
+        s.sendall(len(trailer).to_bytes(3, "big") + bytes([1, 0x5])
+                  + (1).to_bytes(4, "big") + trailer)        # trailers+ES
+        r = s.makefile("rb")
+        saw_status = None
+        while saw_status is None:
+            head = r.read(9)
+            if len(head) < 9:
+                break
+            length = int.from_bytes(head[:3], "big")
+            ftype, flags = head[3], head[4]
+            payload = r.read(length)
+            if ftype == 1:
+                saw_status = payload[0]
+        assert saw_status == 0x89  # 204: the pref was ingested
