@@ -185,3 +185,76 @@ def test_initialize_multihost_noop_without_config():
     assert cfg.get_optional_string(
         "oryx.distributed.coordinator-address") is None
     assert not cfg.has_path("oryx.distributed.num-processes")
+
+
+@pytest.mark.slow
+def test_distributed_kmeans_moderate_scale_agreement():
+    """Distributed k-means at 120k points on the 8-way mesh (three
+    orders of magnitude above the dryrun smoke test): the per-device
+    partial-sum + psum aggregation must land on the same planted
+    centers a single-device train finds."""
+    from oryx_tpu.app.kmeans.trainer import train_kmeans
+    from oryx_tpu.parallel.kmeans_dist import train_kmeans_distributed
+
+    rng = np.random.default_rng(21)
+    k, d = 12, 8
+    true_c = rng.standard_normal((k, d)).astype(np.float32) * 9
+    pts = (true_c[rng.integers(0, k, 120_000)]
+           + rng.standard_normal((120_000, d)).astype(np.float32))
+    mesh = build_mesh(8)
+    dist = train_kmeans_distributed(pts, k=k, iterations=12, mesh=mesh,
+                                    seed=6)
+    dist_centers = np.stack([c.center for c in dist])
+    # the distributed psum aggregation must land on the SAME model the
+    # single-device trainer finds from the same seed (k-means|| may
+    # legitimately merge planted clusters; agreement is the property)
+    single = train_kmeans(pts, k=k, iterations=12, seed=6)
+    single_centers = np.stack([c.center for c in single])
+    ds = np.linalg.norm(single_centers[:, None, :]
+                        - dist_centers[None, :, :], axis=2)
+    assert ds.min(axis=1).max() < 0.05, ds.min(axis=1)
+    assert ds.min(axis=0).max() < 0.05, ds.min(axis=0)
+    # and most planted centers are recovered (quality sanity)
+    dd = np.linalg.norm(true_c[:, None, :] - dist_centers[None, :, :],
+                        axis=2).min(axis=1)
+    assert (dd < 0.6).sum() >= k - 3, dd
+    assert sum(c.count for c in dist) == 120_000
+
+
+@pytest.mark.slow
+def test_distributed_forest_moderate_scale_quality():
+    """Distributed forest at 40k examples x 8 predictors, depth 8 (the
+    dryrun exercises depth 2 on a few dozen rows): per-level histogram
+    psums must still produce a forest that generalizes on held-out
+    rows."""
+    from oryx_tpu.app.classreg import Example
+    from oryx_tpu.app.rdf.trainer import train_forest
+    from oryx_tpu.app.schema import InputSchema
+    from oryx_tpu.common.config import from_dict
+
+    rng = np.random.default_rng(22)
+    n, p = 40_000, 8
+    x = rng.uniform(-1, 1, (n, p)).astype(np.float32)
+    y = ((x[:, 0] + 0.5 * x[:, 1] - 0.25 * x[:, 2]
+          + 0.1 * x[:, 3]) > 0).astype(np.int32)
+    names = [f"f{i}" for i in range(p)] + ["label"]
+    schema = InputSchema(from_dict({
+        "oryx.input-schema.feature-names": names,
+        "oryx.input-schema.numeric-features": names[:-1],
+        "oryx.input-schema.target-feature": "label",
+    }))
+    mesh = build_mesh(8)
+    n_test = 4000
+    forest = train_forest(x[n_test:], y[n_test:], schema,
+                          category_counts={}, num_trees=5, max_depth=8,
+                          max_split_candidates=16, impurity="gini",
+                          seed=23, num_classes=2, mesh=mesh)
+    correct = 0
+    probe = rng.choice(n_test, 800, replace=False)
+    for i in probe:
+        votes = [t.find_terminal(
+            Example(None, [float(v) for v in x[i]] + [None])
+        ).prediction.max_category for t in forest.trees]
+        pred = max(set(votes), key=votes.count)
+        correct += int(pred == y[i])
+    assert correct / len(probe) >= 0.9, correct / len(probe)
