@@ -307,3 +307,47 @@ def test_h2_request_trailers_are_tolerated(h2_server):
             if ftype == 1:
                 saw_status = payload[0]
         assert saw_status == 0x89  # 204: the pref was ingested
+
+
+def test_h2_flow_control_small_window(h2_server):
+    """A client advertising a tiny INITIAL_WINDOW_SIZE must receive the
+    response in window-sized DATA chunks, the server pausing until
+    WINDOW_UPDATEs open credit (the blocked-send branch of
+    _send_response)."""
+    from oryx_tpu.lambda_rt import http2 as h2mod
+
+    enc = HpackEncoder()
+    window = 256
+    with socket.create_connection(("127.0.0.1", h2_server),
+                                  timeout=10) as s:
+        s.sendall(h2mod.PREFACE)
+        # SETTINGS: INITIAL_WINDOW_SIZE=256 (id 0x4)
+        payload = (4).to_bytes(2, "big") + window.to_bytes(4, "big")
+        s.sendall(len(payload).to_bytes(3, "big") + bytes([4, 0])
+                  + (0).to_bytes(4, "big") + payload)
+        block = enc.encode([(":method", "GET"), (":path", "/allItemIDs"),
+                            (":scheme", "http"), (":authority", "a")])
+        s.sendall(len(block).to_bytes(3, "big") + bytes([1, 0x5])
+                  + (1).to_bytes(4, "big") + block)
+        r = s.makefile("rb")
+        body = bytearray()
+        done = False
+        while not done:
+            head = r.read(9)
+            assert len(head) == 9, "connection closed mid-response"
+            length = int.from_bytes(head[:3], "big")
+            ftype, flags = head[3], head[4]
+            payload = r.read(length)
+            if ftype == 0:  # DATA
+                assert length <= window  # never exceeds our credit
+                body += payload
+                done = bool(flags & 0x1)
+                # grant credit back on stream AND connection
+                inc = length.to_bytes(4, "big")
+                for sid in (0, 1):
+                    s.sendall(b"\x00\x00\x04\x08\x00"
+                              + sid.to_bytes(4, "big") + inc)
+            elif ftype == 4 and not flags & 0x1:
+                s.sendall(b"\x00\x00\x00\x04\x01\x00\x00\x00\x00")
+        items = json.loads(bytes(body))
+        assert len(items) == 80  # the full response arrived, chunked
