@@ -102,7 +102,14 @@ def json_or_csv(value: Any, accept: str) -> tuple[bytes, str]:
         if hasattr(value, "to_csv"):
             return (value.to_csv() + "\n").encode(), "text/csv"
         return (str(value) + "\n").encode(), "text/plain"
-    # JSON
+    # JSON — DTO lists take the fragment fast path (a /recommend under
+    # load serializes thousands of IDValue rows per second; the
+    # default-callback protocol costs ~3x per element)
+    if isinstance(value, list) and value \
+            and hasattr(type(value[0]), "to_json_fragment"):
+        return ("[" + ", ".join(v.to_json_fragment() for v in value)
+                + "]").encode(), "application/json"
+
     def _default(o):
         if hasattr(o, "__dict__"):
             return o.__dict__

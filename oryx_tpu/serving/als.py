@@ -19,6 +19,8 @@ from __future__ import annotations
 import dataclasses
 import gzip
 import io
+import json
+import math
 import zipfile
 
 import numpy as np
@@ -44,6 +46,17 @@ class IDValue:
     def to_csv(self) -> str:
         return f"{self.id},{self.value}"
 
+    def to_json_fragment(self) -> str:
+        # hand-built: the hot /recommend path serializes thousands of
+        # these per second and json.dumps' default-callback protocol
+        # costs ~3x (json.encoder C-escapes the id; float repr IS the
+        # JSON float form for finite scores; non-finite scores keep
+        # json.dumps' spelling, which repr would break)
+        v = float(self.value)
+        if not math.isfinite(v):
+            return json.dumps({"id": self.id, "value": v})
+        return f'{{"id": {json.dumps(self.id)}, "value": {v!r}}}'
+
 
 @dataclasses.dataclass
 class IDCount:
@@ -54,6 +67,9 @@ class IDCount:
 
     def to_csv(self) -> str:
         return f"{self.id},{self.count}"
+
+    def to_json_fragment(self) -> str:
+        return f'{{"id": {json.dumps(self.id)}, "count": {int(self.count)}}}'
 
 
 def _als_model(req: Request) -> ALSServingModel:

@@ -129,12 +129,16 @@ class TopNBatcher:
         to cover the transport round trip at the current service rate,
         plus one.  More than this only deepens the on-device queue (each
         extra dispatch adds a full service time to every later request's
-        latency)."""
-        if not np.isfinite(self._wall_min):
+        latency).  Called inside the dispatchers' wait loops — plain
+        float math, no numpy scalars (they cost microseconds each)."""
+        wall_min = self._wall_min
+        if wall_min == float("inf"):
             return len(self._threads)  # unmeasured: let it rip once
-        rtt = max(0.0, self._wall_min - self._exec_ewma)
+        rtt = wall_min - self._exec_ewma
+        if rtt <= 0.0:
+            return 2
         return min(len(self._threads),
-                   1 + max(1, int(np.ceil(rtt / self._exec_ewma))))
+                   1 + max(1, -int(-rtt // self._exec_ewma)))
 
     def _loop(self) -> None:
         while True:
@@ -188,7 +192,11 @@ class TopNBatcher:
                     self._exec_ewma = max(_MIN_EXEC_S,
                                           min(self._exec_ewma, wall))
                     self._last_completion = now
-                    self._cond.notify_all()
+                    # wake a couple of waiters, not the whole pipeline:
+                    # notify_all costs O(threads) lock churn per
+                    # completion, and pacing waiters self-wake on their
+                    # timeout anyway
+                    self._cond.notify(2)
             if stopped:
                 return
 
