@@ -84,7 +84,7 @@ def test_hpack_encoder_is_decodable_and_uses_static_indexing():
 
 # -- live h2 against curl/nghttp2 --------------------------------------------
 
-def _serving_app():
+def _serving_app(**app_kwargs):
     from oryx_tpu.app.als.serving_model import ALSServingModel
     from oryx_tpu.bench.load import StaticModelManager
     from oryx_tpu.lambda_rt.http import HttpApp, make_server
@@ -106,13 +106,14 @@ def _serving_app():
     batcher = TopNBatcher(pipeline=2)
     producer = InProcTopicProducer(
         f"memory://h2test-{_time.monotonic_ns()}", "In")
+    app_kwargs.setdefault("read_only", False)
     app = HttpApp(
         framework_resources.ROUTES + als_resources.ROUTES,
         context={"model_manager": StaticModelManager(),
                  "input_producer": producer, "config": None,
                  "min_model_load_fraction": 0.0,
                  "top_n_batcher": batcher},
-        read_only=False)
+        **app_kwargs)
     return app, batcher, make_server
 
 
@@ -209,8 +210,7 @@ def test_multiple_streams_on_one_connection(h2_server):
         assert json.loads(bytes(body))  # allItemIDs payload on stream 3
 
 
-def test_curl_h2_over_tls_alpn(tmp_path):
-    """Full ALPN negotiation: curl --http2 over TLS must land on h2."""
+def _tls_server_context(tmp_path):
     try:
         from cryptography import x509
         from cryptography.hazmat.primitives import hashes, serialization
@@ -237,6 +237,12 @@ def test_curl_h2_over_tls_alpn(tmp_path):
                             serialization.NoEncryption()))
     ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
     ctx.load_cert_chain(str(pem))
+    return ctx
+
+
+def test_curl_h2_over_tls_alpn(tmp_path):
+    """Full ALPN negotiation: curl --http2 over TLS must land on h2."""
+    ctx = _tls_server_context(tmp_path)
     app, batcher, make_server = _serving_app()
     server = make_server(app, 0, ssl_context=ctx)
     port = server.server_address[1]
@@ -351,3 +357,134 @@ def test_h2_flow_control_small_window(h2_server):
                 s.sendall(b"\x00\x00\x00\x04\x01\x00\x00\x00\x00")
         items = json.loads(bytes(body))
         assert len(items) == 80  # the full response arrived, chunked
+
+
+def test_curl_h2_digest_auth_and_errors(tmp_path):
+    """DIGEST auth and the plain-text error pages work unchanged over
+    h2.  Runs over TLS because the challenge/response dance is two
+    requests on one connection — the path curl 7.88's h2c reuse bug
+    breaks (see test_multiple_streams_on_one_connection)."""
+    ctx = _tls_server_context(tmp_path)  # skippable step FIRST
+    app, batcher, make_server = _serving_app(read_only=True,
+                                             user_name="oryx",
+                                             password="pw")
+    server = make_server(app, 0, ssl_context=ctx)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    base = f"https://127.0.0.1:{port}"
+    try:
+        # no credentials -> 401 over h2
+        r = _curl(["--http2", "-k", "-o", "/dev/null",
+                   "-w", "%{http_code}\n%{http_version}",
+                   f"{base}/allItemIDs"])
+        code, ver = r.stdout.split("\n")
+        assert r.returncode == 0 and code == "401" and ver == "2", r.stdout
+        # digest credentials -> 200 over h2
+        r = _curl(["--http2", "-k", "--digest", "-u", "oryx:pw",
+                   "-o", "/dev/null", "-w", "%{http_code}",
+                   f"{base}/allItemIDs"])
+        assert r.returncode == 0 and r.stdout == "200", (r.stdout, r.stderr)
+        # 404 error page over h2 keeps the plain-text error body
+        r = _curl(["--http2", "-k", "--digest", "-u", "oryx:pw",
+                   "-w", "\n%{http_code}", f"{base}/nope"])
+        body, code = r.stdout.rsplit("\n", 1)
+        assert code == "404" and "HTTP 404" in body
+    finally:
+        server.shutdown()
+        batcher.close()
+
+
+def test_h2_flow_control_small_window(h2_server):
+    """A client advertising a tiny INITIAL_WINDOW_SIZE must receive the
+    response in window-sized DATA chunks, the server pausing until
+    WINDOW_UPDATEs open credit (the blocked-send branch of
+    _send_response)."""
+    from oryx_tpu.lambda_rt import http2 as h2mod
+
+    enc = HpackEncoder()
+    window = 256
+    with socket.create_connection(("127.0.0.1", h2_server),
+                                  timeout=10) as s:
+        s.sendall(h2mod.PREFACE)
+        # SETTINGS: INITIAL_WINDOW_SIZE=256 (id 0x4)
+        payload = (4).to_bytes(2, "big") + window.to_bytes(4, "big")
+        s.sendall(len(payload).to_bytes(3, "big") + bytes([4, 0])
+                  + (0).to_bytes(4, "big") + payload)
+        block = enc.encode([(":method", "GET"), (":path", "/allItemIDs"),
+                            (":scheme", "http"), (":authority", "a")])
+        s.sendall(len(block).to_bytes(3, "big") + bytes([1, 0x5])
+                  + (1).to_bytes(4, "big") + block)
+        r = s.makefile("rb")
+        body = bytearray()
+        done = False
+        while not done:
+            head = r.read(9)
+            assert len(head) == 9, "connection closed mid-response"
+            length = int.from_bytes(head[:3], "big")
+            ftype, flags = head[3], head[4]
+            payload = r.read(length)
+            if ftype == 0:  # DATA
+                assert length <= window  # never exceeds our credit
+                body += payload
+                done = bool(flags & 0x1)
+                # grant credit back on stream AND connection
+                inc = length.to_bytes(4, "big")
+                for sid in (0, 1):
+                    s.sendall(b"\x00\x00\x04\x08\x00"
+                              + sid.to_bytes(4, "big") + inc)
+            elif ftype == 4 and not flags & 0x1:
+                s.sendall(b"\x00\x00\x00\x04\x01\x00\x00\x00\x00")
+        items = json.loads(bytes(body))
+        assert len(items) == 80  # the full response arrived, chunked
+
+
+def test_curl_h2_digest_auth_and_errors(tmp_path):
+    """DIGEST auth and the plain-text error pages work unchanged over
+    h2.  Runs over TLS because the challenge/response dance is two
+    requests on one connection — the path curl 7.88's h2c reuse bug
+    breaks (see test_multiple_streams_on_one_connection)."""
+    from oryx_tpu.lambda_rt.http import HttpApp, make_server
+    from oryx_tpu.serving import als as als_resources
+    from oryx_tpu.serving import framework as framework_resources
+    from oryx_tpu.bench.load import StaticModelManager
+    from oryx_tpu.app.als.serving_model import ALSServingModel
+    from oryx_tpu.serving.batcher import TopNBatcher
+
+    rng = np.random.default_rng(1)
+    model = ALSServingModel(features=4, implicit=True)
+    model.Y.bulk_load([f"i{j}" for j in range(20)],
+                      rng.standard_normal((20, 4)).astype(np.float32))
+    model.X.bulk_load(["u0"], rng.standard_normal((1, 4)).astype(np.float32))
+    StaticModelManager.model = model
+    batcher = TopNBatcher(pipeline=2)
+    app = HttpApp(
+        framework_resources.ROUTES + als_resources.ROUTES,
+        context={"model_manager": StaticModelManager(),
+                 "input_producer": None, "config": None,
+                 "min_model_load_fraction": 0.0,
+                 "top_n_batcher": batcher},
+        read_only=True, user_name="oryx", password="pw")
+    server = make_server(app, 0, ssl_context=_tls_server_context(tmp_path))
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    base = f"https://127.0.0.1:{port}"
+    try:
+        # no credentials -> 401 over h2
+        r = _curl(["--http2", "-k", "-o", "/dev/null",
+                   "-w", "%{http_code}\n%{http_version}",
+                   f"{base}/allItemIDs"])
+        code, ver = r.stdout.split("\n")
+        assert r.returncode == 0 and code == "401" and ver == "2", r.stdout
+        # digest credentials -> 200 over h2
+        r = _curl(["--http2", "-k", "--digest", "-u", "oryx:pw",
+                   "-o", "/dev/null", "-w", "%{http_code}",
+                   f"{base}/allItemIDs"])
+        assert r.returncode == 0 and r.stdout == "200", (r.stdout, r.stderr)
+        # 404 error page over h2 keeps the plain-text error body
+        r = _curl(["--http2", "-k", "--digest", "-u", "oryx:pw",
+                   "-w", "\n%{http_code}", f"{base}/nope"])
+        body, code = r.stdout.rsplit("\n", 1)
+        assert code == "404" and "HTTP 404" in body
+    finally:
+        server.shutdown()
+        batcher.close()
