@@ -329,6 +329,16 @@ class ALSServingModel(FactorModelBase, ServingModel):
 
     # -- scoring -------------------------------------------------------------
 
+    def metrics(self) -> dict:
+        """App-level gauges merged into /metrics (framework hook)."""
+        return {
+            "users": len(self.X),
+            "items": len(self.Y),
+            # exact-scan recomputes forced by a failed streaming top-k
+            # certificate; nonzero is worth an operator's attention
+            "twophase_fallbacks": self.twophase_fallbacks,
+        }
+
     def _lsh_active(self) -> bool:
         """True when this model's LSH configuration actually prunes
         (hashes exist and the Hamming ball is a strict subset)."""

@@ -89,6 +89,7 @@ class TopNBatcher:
             t.start()
         # drain-size histogram, exposed for tests and the metrics surface
         self.batch_sizes: list[int] = []
+        self.total_dispatches = 0
 
     def top_n(self, model, how_many: int, user_vector: np.ndarray,
               exclude: Iterable[str] = ()) -> list[tuple[str, float]]:
@@ -114,6 +115,22 @@ class TopNBatcher:
         if job.error is not None:
             raise job.error
         return job.result
+
+    def stats(self) -> dict:
+        """Live pacing/batching state for the /metrics surface."""
+        with self._cond:
+            sizes = self.batch_sizes[-1000:]
+            return {
+                "dispatches": self.total_dispatches,
+                "mean_recent_batch": round(sum(sizes) / len(sizes), 1)
+                if sizes else 0.0,
+                "service_time_ms": round(self._exec_ewma * 1e3, 2),
+                "round_trip_floor_ms": round(self._wall_min * 1e3, 1)
+                if self._wall_min != float("inf") else None,
+                "in_flight": self._in_flight,
+                "in_flight_target": self._in_flight_target(),
+                "pending": len(self._pending),
+            }
 
     def close(self) -> None:
         with self._cond:
@@ -216,8 +233,12 @@ class TopNBatcher:
             except BaseException as e:  # noqa: BLE001 — surfaced per job
                 for j in group:
                     j.error = e
-            self.batch_sizes.append(len(group))
-            if len(self.batch_sizes) > 10000:
-                del self.batch_sizes[:5000]
+            with self._cond:
+                # under the lock: up to `pipeline` dispatcher threads
+                # land here concurrently, and a bare += loses updates
+                self.batch_sizes.append(len(group))
+                self.total_dispatches += 1
+                if len(self.batch_sizes) > 10000:
+                    del self.batch_sizes[:5000]
             for j in group:
                 j.done.set()

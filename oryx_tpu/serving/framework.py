@@ -50,16 +50,28 @@ def _ready(req: Request):
 def _metrics(req: Request):
     """Per-route request counts, error counts, and latency percentiles
     (the reference exposes only logs + Spark UI — SURVEY §5.1/5.5; this
-    is the serving-side step-metrics surface ops parity needs)."""
+    is the serving-side step-metrics surface ops parity needs), plus the
+    request micro-batcher's live pacing state and the streaming top-k
+    certificate-fallback counter — the two internals an operator needs
+    when throughput or result-exactness questions come up."""
     registry = req.context.get("metrics")
     if registry is None:
         raise OryxServingException(404, "metrics not enabled")
     model = req.context["model_manager"].get_model()
-    return {
+    out = {
         "routes": registry.snapshot(),
         "model_fraction_loaded":
             model.get_fraction_loaded() if model is not None else 0.0,
     }
+    batcher = req.context.get("top_n_batcher")
+    if batcher is not None:
+        out["scoring_batcher"] = batcher.stats()
+    # app-agnostic hook: a serving model may contribute its own gauges
+    # (e.g. the ALS model's streaming top-k fallback counter)
+    app_metrics = getattr(model, "metrics", None)
+    if callable(app_metrics):
+        out["model_metrics"] = app_metrics()
+    return out
 
 
 ROUTES = [

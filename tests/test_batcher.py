@@ -256,3 +256,40 @@ def test_pacing_relearns_after_hot_swap():
             (ewma_slow, batcher._exec_ewma)
     finally:
         batcher.close()
+
+
+def test_metrics_surface_exposes_batcher_and_fallback_state():
+    """/metrics reports the pacing/batching internals and the streaming
+    top-k certificate-fallback counter."""
+    import json as _json
+    import urllib.request
+
+    BatcherMockManager.model = _small_model(users=4, items=30)
+    cfg = from_dict({
+        "oryx.serving.model-manager-class":
+            "tests.test_batcher.BatcherMockManager",
+        "oryx.serving.application-resources": "oryx_tpu.serving.als",
+        "oryx.input-topic.broker": None,
+        "oryx.input-topic.partitions": 1,
+        "oryx.input-topic.message.topic": None,
+        "oryx.update-topic.broker": None,
+        "oryx.update-topic.message.topic": None,
+    })
+    layer = ServingLayer(cfg, port=0)
+    layer.start()
+    try:
+        base = f"http://127.0.0.1:{layer.port}"
+        for u in range(4):
+            with urllib.request.urlopen(f"{base}/recommend/u{u}",
+                                        timeout=10) as r:
+                assert r.status == 200
+        with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
+            m = _json.loads(r.read())
+        sb = m["scoring_batcher"]
+        assert sb["dispatches"] >= 4 and sb["mean_recent_batch"] >= 1
+        assert sb["service_time_ms"] >= 0
+        assert sb["in_flight_target"] >= 1
+        assert m["model_metrics"]["twophase_fallbacks"] == 0
+        assert m["model_metrics"]["items"] == 30
+    finally:
+        layer.close()

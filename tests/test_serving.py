@@ -471,7 +471,8 @@ def test_metrics_endpoint(server):
         _get(server, "/recommend/U2?howMany=2")
     _status_of(server, "/recommend/nobody")  # 404 counted as error
     m = _get(server, "/metrics")
-    assert set(m) == {"routes", "model_fraction_loaded"}
+    assert set(m) == {"routes", "model_fraction_loaded",
+                      "scoring_batcher", "model_metrics"}
     rec = m["routes"]["GET /recommend/{userID}"]
     assert rec["count"] >= 4
     assert rec["errors"] >= 1
