@@ -511,8 +511,11 @@ class ALSServingModel(FactorModelBase, ServingModel):
                 for w, (ts, ti, cert) in enumerate(fetched):
                     if not cert.all():
                         # approx block selection missed a head block for
-                        # some row; recompute on the exact scan
-                        self.twophase_fallbacks += 1
+                        # some row; recompute on the exact scan.  Count
+                        # per certificate-failing row, under the lock —
+                        # batcher dispatcher threads race on this gauge.
+                        with self._bucket_lock:
+                            self.twophase_fallbacks += int((~cert).sum())
                         ts, ti = jax.device_get(
                             _batch_top_n_chunked_kernel(
                                 vecs, windows[w], active, buckets, hp,

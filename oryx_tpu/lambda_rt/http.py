@@ -184,6 +184,30 @@ class HttpApp:
 
     # -- dispatch ------------------------------------------------------------
 
+    @staticmethod
+    def _drain_body(handler) -> None:
+        """Keep-alive hygiene for error paths that return before the
+        request body is read: leftover bytes on the socket would be
+        parsed as the next request line (spurious 400 + close).  Reads
+        and discards a bounded body; past the bound (or with chunked
+        framing, which this server never negotiates) the connection is
+        marked for close instead."""
+        if not hasattr(handler, "_close"):
+            return  # h2 adapter: body already fully buffered per stream
+        try:
+            length = int(handler.headers.get("Content-Length") or 0)
+        except ValueError:
+            length = 0
+        if handler.headers.get("Transfer-Encoding"):
+            handler._close = True
+            return
+        if length <= 0:
+            return
+        if length > (1 << 20):
+            handler._close = True
+            return
+        handler.rfile.read(length)
+
     def handle(self, handler: BaseHTTPRequestHandler) -> None:
         t0 = time.perf_counter()
         handler._oryx_route = None
@@ -204,6 +228,7 @@ class HttpApp:
 
     def _handle(self, handler: BaseHTTPRequestHandler) -> None:
         if not self._auth_ok(handler):
+            self._drain_body(handler)
             self._challenge(handler)
             return
         parsed = urllib.parse.urlparse(handler.path)
@@ -224,10 +249,17 @@ class HttpApp:
                 continue
             handler._oryx_route = f"{route.method} {route.pattern}"
             if route.mutates and self.read_only:
+                self._drain_body(handler)
                 self._send_error(handler, 403, "endpoint is read-only")
                 return
-            length = int(handler.headers.get("Content-Length") or 0)
-            body = handler.rfile.read(length) if length else b""
+            try:
+                length = int(handler.headers.get("Content-Length") or 0)
+            except ValueError:
+                if hasattr(handler, "_close"):
+                    handler._close = True  # framing unknown: don't reuse
+                self._send_error(handler, 400, "bad Content-Length")
+                return
+            body = handler.rfile.read(length) if length > 0 else b""
             if handler.headers.get("Content-Encoding", "") == "gzip" and body:
                 try:
                     body = gzip.decompress(body)
@@ -252,6 +284,7 @@ class HttpApp:
                        handler.headers.get("Accept", ""),
                        "gzip" in handler.headers.get("Accept-Encoding", ""))
             return
+        self._drain_body(handler)
         if matched_path:
             self._send_error(handler, 405, "method not allowed")
         else:
@@ -396,12 +429,17 @@ def make_server(app: HttpApp, port: int,
                 # the stdlib handler's LineTooLong/_MAXHEADERS guards:
                 # reject rather than let one client grow host memory or
                 # split an oversized line into garbage headers
-                if len(h) > 65536 or len(headers) >= 128:
+                # ... and RFC 9112 §5: a field line without ':' or an
+                # obs-fold continuation (leading SP/HTAB) is rejected —
+                # accepting either diverges from the front proxies this
+                # sits behind (request-smuggling surface)
+                k, sep, v = h.partition(b":")
+                if (len(h) > 65536 or len(headers) >= 128 or not sep
+                        or h[:1] in (b" ", b"\t")):
                     self.wfile.write(b"HTTP/1.1 400 Bad Request\r\n"
                                      b"Content-Length: 0\r\n\r\n")
                     self.wfile.flush()
                     return False
-                k, _, v = h.partition(b":")
                 headers[k.decode("latin-1").strip().title()] = \
                     v.decode("latin-1").strip()
             self.headers = headers
@@ -416,6 +454,7 @@ def make_server(app: HttpApp, port: int,
             if self.command in _KNOWN_METHODS:
                 app.handle(self)
             else:
+                app._drain_body(self)
                 app._send_error(self, 405, "method not allowed")
             self.wfile.flush()
             return not self._close

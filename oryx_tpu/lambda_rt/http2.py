@@ -51,12 +51,20 @@ MAX_FRAME_SIZE = 16384  # what we advertise and enforce on receipt
 # error codes
 NO_ERROR, PROTOCOL_ERROR, FLOW_CONTROL_ERROR = 0x0, 0x1, 0x3
 FRAME_SIZE_ERROR = 0x6
+REFUSED_STREAM = 0x7
 ENHANCE_YOUR_CALM = 0xB
 
 # per-request resource bounds, mirroring the HTTP/1.1 parser's
 # header-count/line-length guards (lambda_rt/http.py)
 MAX_HEADER_BLOCK = 65536
 MAX_BODY_BYTES = 64 * 1024 * 1024
+# what we advertise in SETTINGS_MAX_CONCURRENT_STREAMS — and enforce:
+# streams opened past this are refused with RST_STREAM(REFUSED_STREAM)
+MAX_CONCURRENT_STREAMS = 128
+# aggregate request-body bytes buffered across all open streams of one
+# connection; one client holding many streams open with partial DATA
+# must not grow host memory without bound
+MAX_CONN_BUFFERED = 256 * 1024 * 1024
 
 
 class H2Error(Exception):
@@ -115,6 +123,16 @@ class _Connection:
         self.max_seen_stream = 0
         self.goaway = False
         self._wlock = threading.Lock()
+        # streams refused past MAX_CONCURRENT_STREAMS: in-flight frames
+        # for them must be ignored, not treated as idle-stream errors.
+        # Insertion-ordered so overflow trims the oldest ids (ids only
+        # grow, so old entries are the ones whose DATA has drained).
+        self._refused: dict[int, None] = {}
+        # queued completed requests + re-entrancy latch so a request
+        # that completes while a response is blocked on flow control is
+        # answered iteratively, never by nested _respond recursion
+        self._response_q: list[_Stream] = []
+        self._responding = False
 
     # -- frame IO ------------------------------------------------------------
 
@@ -149,7 +167,8 @@ class _Connection:
     def run(self) -> None:
         # our SETTINGS first (defaults; advertise a concurrency bound)
         self.write_frame(SETTINGS, 0, 0, struct.pack(
-            "!HI", SETTINGS_MAX_CONCURRENT_STREAMS, 128))
+            "!HI", SETTINGS_MAX_CONCURRENT_STREAMS,
+            MAX_CONCURRENT_STREAMS))
         try:
             while not self.goaway:
                 try:
@@ -242,12 +261,28 @@ class _Connection:
                 # client must not grow host memory without bound
                 raise H2Error(ENHANCE_YOUR_CALM, "header block too large")
         self.max_seen_stream = max(self.max_seen_stream, sid)
-        stream = self.streams.setdefault(
-            sid, _Stream(sid, self.peer_initial_window))
+        # always decode before any refusal: HPACK state is shared across
+        # the connection (RFC 7541 §2.2), so a skipped block would
+        # corrupt every later request's headers
         try:
             decoded = self.decoder.decode(block, max_headers=256)
         except HpackError as e:
             raise H2Error(PROTOCOL_ERROR, f"HPACK: {e}") from e
+        stream = self.streams.get(sid)
+        if stream is None:
+            if sid in self._refused:
+                # trailers for a stream we refused must not resurrect it
+                return
+            if len(self.streams) >= MAX_CONCURRENT_STREAMS:
+                # enforce the advertised SETTINGS_MAX_CONCURRENT_STREAMS
+                self._refused[sid] = None
+                while len(self._refused) > 4096:
+                    self._refused.pop(next(iter(self._refused)))
+                self.write_frame(RST_STREAM, 0, sid,
+                                 struct.pack("!I", REFUSED_STREAM))
+                return
+            stream = self.streams[sid] = _Stream(
+                sid, self.peer_initial_window)
         if stream.headers is None:
             stream.headers = decoded
         # else: request trailers (RFC 9113 §8.1) — fields are legal to
@@ -259,12 +294,23 @@ class _Connection:
     def _on_data(self, flags: int, sid: int, payload: bytes) -> None:
         stream = self.streams.get(sid)
         if stream is None:
+            if sid in self._refused:
+                # in-flight DATA for a stream we refused: drop it, but
+                # replenish the connection window it consumed
+                if payload:
+                    self.write_frame(WINDOW_UPDATE, 0, 0,
+                                     struct.pack("!I", len(payload)))
+                return
             raise H2Error(PROTOCOL_ERROR, f"DATA on idle stream {sid}")
         consumed = len(payload)  # padding counts toward flow control
         payload = self._strip_padding(flags, payload)
         stream.body += payload
         if len(stream.body) > MAX_BODY_BYTES:
             raise H2Error(ENHANCE_YOUR_CALM, "request body too large")
+        if sum(len(s.body) for s in self.streams.values()) \
+                > MAX_CONN_BUFFERED:
+            raise H2Error(ENHANCE_YOUR_CALM,
+                          "aggregate buffered bodies too large")
         if consumed:
             # replenish both windows immediately: requests are consumed
             # whole, so there is no reason to throttle the peer
@@ -289,6 +335,22 @@ class _Connection:
     # -- request dispatch -----------------------------------------------------
 
     def _respond(self, stream: _Stream) -> None:
+        # A response blocked on flow control dispatches incoming frames
+        # inline (_send_response), so another request can complete while
+        # one is mid-send.  Queue it and let the outermost call drain
+        # iteratively — nested _respond calls would otherwise recurse
+        # once per pipelined request while the peer holds windows at 0.
+        self._response_q.append(stream)
+        if self._responding:
+            return
+        self._responding = True
+        try:
+            while self._response_q:
+                self._respond_one(self._response_q.pop(0))
+        finally:
+            self._responding = False
+
+    def _respond_one(self, stream: _Stream) -> None:
         method = path = None
         headers: dict[str, str] = {}
         for name, value in stream.headers or ():

@@ -488,3 +488,53 @@ def test_curl_h2_digest_auth_and_errors(tmp_path):
     finally:
         server.shutdown()
         batcher.close()
+
+
+def test_streams_past_advertised_cap_are_refused(h2_server):
+    """The server advertises SETTINGS_MAX_CONCURRENT_STREAMS=128 and
+    must enforce it: the 129th concurrently open stream is refused with
+    RST_STREAM(REFUSED_STREAM), while HPACK state stays consistent so
+    already-open streams still complete."""
+    from oryx_tpu.lambda_rt import http2 as h2mod
+
+    enc = HpackEncoder()
+
+    def headers_frame(sid, end_stream=False):
+        block = enc.encode([(":method", "GET"), (":path", "/ready"),
+                            (":scheme", "http"), (":authority", "a")])
+        flags = 0x4 | (0x1 if end_stream else 0)
+        return (len(block).to_bytes(3, "big") + bytes([1, flags])
+                + sid.to_bytes(4, "big") + block)
+
+    with socket.create_connection(("127.0.0.1", h2_server),
+                                  timeout=10) as s:
+        s.sendall(h2mod.PREFACE)
+        s.sendall(b"\x00\x00\x00\x04\x00\x00\x00\x00\x00")  # SETTINGS
+        # 128 open streams (no END_STREAM), then one more
+        for i in range(129):
+            s.sendall(headers_frame(2 * i + 1))
+        r = s.makefile("rb")
+        rst = None
+        while rst is None:
+            head = r.read(9)
+            length = int.from_bytes(head[:3], "big")
+            ftype, flags = head[3], head[4]
+            sid = int.from_bytes(head[5:9], "big") & 0x7FFFFFFF
+            payload = r.read(length)
+            if ftype == 4 and not flags & 0x1:
+                s.sendall(b"\x00\x00\x00\x04\x01\x00\x00\x00\x00")
+            elif ftype == 3:  # RST_STREAM
+                rst = (sid, int.from_bytes(payload, "big"))
+        assert rst == (257, 0x7), rst  # REFUSED_STREAM on the 129th
+        # stream 1 (admitted) still completes: empty DATA + END_STREAM
+        s.sendall(b"\x00\x00\x00\x00\x01" + (1).to_bytes(4, "big"))
+        status = None
+        while status is None:
+            head = r.read(9)
+            length = int.from_bytes(head[:3], "big")
+            ftype, _, sid = head[3], head[4], \
+                int.from_bytes(head[5:9], "big") & 0x7FFFFFFF
+            payload = r.read(length)
+            if ftype == 1 and sid == 1:
+                status = payload[0]
+        assert status == 0x89  # :status 204, HPACK static index 9

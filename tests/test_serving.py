@@ -570,3 +570,43 @@ def test_keep_alive_multiple_requests_one_connection(server):
                     clen = int(h.split(b":")[1])
             if clen:
                 r.read(clen)
+
+
+def test_keep_alive_survives_post_to_404_with_body(server):
+    """Error paths that return before the body is read must drain it;
+    otherwise the leftover bytes parse as the next request line."""
+    import socket
+    body = b"x" * 300
+    with socket.create_connection(("127.0.0.1", server.port), timeout=10) as s:
+        r = s.makefile("rb")
+        s.sendall(b"POST /no/such/path HTTP/1.1\r\nHost: a\r\n"
+                  b"Content-Length: %d\r\n\r\n" % len(body) + body)
+        status = r.readline()
+        assert b"404" in status, status
+        clen = 0
+        while True:
+            h = r.readline()
+            if h in (b"\r\n", b"\n", b""):
+                break
+            if h.lower().startswith(b"content-length:"):
+                clen = int(h.split(b":")[1])
+        r.read(clen)
+        # the connection must still speak clean HTTP
+        s.sendall(b"GET /ready HTTP/1.1\r\nHost: a\r\n\r\n")
+        assert b"204" in r.readline()
+
+
+def test_header_line_without_colon_rejected(server):
+    import socket
+    with socket.create_connection(("127.0.0.1", server.port), timeout=10) as s:
+        s.sendall(b"GET /ready HTTP/1.1\r\nHost: a\r\n"
+                  b"not-a-header-line\r\n\r\n")
+        assert b"400" in s.makefile("rb").readline()
+
+
+def test_obs_fold_continuation_rejected(server):
+    import socket
+    with socket.create_connection(("127.0.0.1", server.port), timeout=10) as s:
+        s.sendall(b"GET /ready HTTP/1.1\r\nHost: a\r\n"
+                  b"X-A: one\r\n two\r\n\r\n")
+        assert b"400" in s.makefile("rb").readline()
