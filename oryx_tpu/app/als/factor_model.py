@@ -89,11 +89,15 @@ class SolverCache:
 class FactorModelBase:
     """X/Y stores + expected-ID accounting + cached solvers."""
 
-    def __init__(self, features: int, implicit: bool, dtype="float32"):
+    def __init__(self, features: int, implicit: bool, dtype="float32",
+                 item_sharding=None):
         self.features = features
         self.implicit = implicit
         self.X = FeatureVectorStore(features, dtype=dtype)
-        self.Y = FeatureVectorStore(features, dtype=dtype)
+        # item matrix optionally row-sharded over a device mesh — the
+        # serving capacity mode past one chip's HBM (P4/P5)
+        self.Y = FeatureVectorStore(features, dtype=dtype,
+                                    device_sharding=item_sharding)
         self._expected_user_ids: set[str] = set()
         self._expected_item_ids: set[str] = set()
         self._expected_lock = threading.Lock()

@@ -39,6 +39,12 @@ class ALSServingModelManager(AbstractServingModelManager):
             config.get_optional_string("oryx.als.rescorer-provider-class"))
         self.sample_rate = config.get_double("oryx.als.sample-rate")
         self.factor_dtype = config.get_string("oryx.als.factor-dtype")
+        # P4/P5 scale-out: shard the item matrix over a device mesh
+        # (oryx.serving.api.item-shards; 1 = single-chip scan)
+        self.item_shards = config.get_int("oryx.serving.api.item-shards")
+        if self.item_shards < 1 or (self.item_shards
+                                    & (self.item_shards - 1)):
+            raise ValueError("item-shards must be a power of two >= 1")
         # fail at boot, not hours later on the consumer thread when the
         # first MODEL message finally constructs the serving model
         from .feature_vectors import resolve_dtype
@@ -88,7 +94,8 @@ class ALSServingModelManager(AbstractServingModelManager):
                 self.model = ALSServingModel(features, implicit,
                                              self.sample_rate,
                                              self.rescorer_provider,
-                                             dtype=self.factor_dtype)
+                                             dtype=self.factor_dtype,
+                                             item_shards=self.item_shards)
             _log.info("Updating model")
             x_ids = set(pmml_io.get_extension_content(pmml, "XIDs") or [])
             y_ids = set(pmml_io.get_extension_content(pmml, "YIDs") or [])

@@ -263,8 +263,41 @@ class ALSServingModel(FactorModelBase, ServingModel):
 
     def __init__(self, features: int, implicit: bool,
                  sample_rate: float = 1.0, rescorer_provider=None,
-                 dtype="float32"):
-        super().__init__(features, implicit, dtype=dtype)
+                 dtype="float32", item_shards: int = 1, mesh=None):
+        """``item_shards`` > 1 row-shards the item matrix over that many
+        devices (``oryx.serving.api.item-shards``) and routes the
+        dot-product top-N scan through one SPMD program with an
+        on-device top-k merge — the serving mode for item matrices past
+        one chip's HBM (reference's partitioned scan,
+        PartitionedFeatureVectors.java:84-148 via
+        ALSServingModel.java:265-280).  LSH pruning is bypassed in
+        sharded mode (it is a single-chip optimization); cosine and
+        rescorer paths run on the sharded arrays through XLA's
+        sharding propagation.  ``mesh`` overrides the auto-built 1-D
+        mesh (tests)."""
+        self._item_shards = int(item_shards)
+        self._mesh = None
+        item_sharding = None
+        if self._item_shards > 1:
+            from jax.sharding import (Mesh, NamedSharding,
+                                      PartitionSpec)
+
+            if mesh is None:
+                devs = jax.devices()
+                if len(devs) < self._item_shards:
+                    raise ValueError(
+                        f"item-shards={self._item_shards} but only "
+                        f"{len(devs)} devices visible")
+                mesh = Mesh(
+                    np.array(devs[:self._item_shards]), ("items",))
+            self._mesh = mesh
+            self._mesh_axis = mesh.axis_names[0]
+            item_sharding = NamedSharding(
+                mesh, PartitionSpec(self._mesh_axis, None))
+            from ...parallel.serving_dist import ShardKernelCache
+            self._shard_kernels = ShardKernelCache(mesh, self._mesh_axis)
+        super().__init__(features, implicit, dtype=dtype,
+                         item_sharding=item_sharding)
         self.rescorer_provider = rescorer_provider
         self._known_items: dict[str, set[str]] = {}
         # incremental item -> #users-who-know-it counts, maintained on
@@ -341,8 +374,11 @@ class ALSServingModel(FactorModelBase, ServingModel):
 
     def _lsh_active(self) -> bool:
         """True when this model's LSH configuration actually prunes
-        (hashes exist and the Hamming ball is a strict subset)."""
-        return (self.lsh is not None and self.lsh.num_hashes > 0
+        (hashes exist and the Hamming ball is a strict subset).  Always
+        False in sharded mode: LSH is a single-chip optimization, and
+        the sharded exact scan already splits the bandwidth bill."""
+        return (self._item_shards == 1 and self.lsh is not None
+                and self.lsh.num_hashes > 0
                 and self.lsh.max_bits_differing < self.lsh.num_hashes)
 
     def warm_serving_kernels(self, how_many: int = 10,
@@ -358,6 +394,8 @@ class ALSServingModel(FactorModelBase, ServingModel):
             self.top_n_batch(how_many,
                              np.zeros((b, self.features), np.float32))
             b *= 2
+        if self._item_shards > 1:
+            return  # the loop above already warmed the SPMD merge kernel
         vecs, active, version = self.Y.device_arrays_versioned()
         n_rows = int(vecs.shape[0])
         k = min(_pad_k(how_many), n_rows)
@@ -385,7 +423,8 @@ class ALSServingModel(FactorModelBase, ServingModel):
             return self._item_buckets
 
     def _lsh_mask(self, query_vec: np.ndarray | None, vecs, version, active):
-        if self.lsh is None or query_vec is None or self.lsh.num_hashes == 0:
+        if self._item_shards > 1 or self.lsh is None or query_vec is None \
+                or self.lsh.num_hashes == 0:
             return active
         buckets = self._cached_buckets(vecs, version)
         return active & self.lsh.candidate_mask(query_vec, buckets)
@@ -476,6 +515,8 @@ class ALSServingModel(FactorModelBase, ServingModel):
             raise ValueError("one how_many per user vector required")
         excl = [set(e) for e in exclude] if exclude is not None \
             else [set()] * n_req
+        if self._item_shards > 1:
+            return self._sharded_top_n_batch(hm, Q, excl, use_lsh)
         vecs, active, version = self.Y.device_arrays_versioned()
         n_rows = int(vecs.shape[0])
         k = min(_pad_k(max(h + len(e) for h, e in zip(hm, excl))), n_rows)
@@ -540,6 +581,40 @@ class ALSServingModel(FactorModelBase, ServingModel):
             # fetch both outputs in ONE host round-trip (matters when the
             # device sits behind a high-latency transport)
             top_scores, top_idx = jax.device_get(out_dev)
+        return self._decode_top_n(top_scores, top_idx, hm, excl, n_req,
+                                  k < n_rows, np.asarray(user_vectors,
+                                                         np.float32),
+                                  use_lsh)
+
+    def _sharded_top_n_batch(self, hm: list[int], Q: np.ndarray,
+                             excl: list[set[str]],
+                             use_lsh: bool) -> list[list[tuple[str, float]]]:
+        """Batched top-N over the mesh-sharded item matrix: per-shard
+        top-k, one all_gather, on-device merge (the SPMD kernel shared
+        with parallel/serving_dist.ShardedItemScorer)."""
+        n_req = Q.shape[0]
+        vecs, active, _ = self.Y.device_arrays_versioned()
+        n_rows = int(vecs.shape[0])
+        k = min(_pad_k(max(h + len(e) for h, e in zip(hm, excl))), n_rows)
+        b_pad = _pad_k(n_req)
+        if b_pad != n_req:
+            Q = np.concatenate(
+                [Q, np.zeros((b_pad - n_req, Q.shape[1]), np.float32)])
+        top_scores, top_idx = jax.device_get(self._shard_kernels.top_k(
+            vecs, active, self._shard_kernels.replicate(Q), k))
+        window = min(k, top_scores.shape[1])
+        return self._decode_top_n(top_scores, top_idx, hm, excl, n_req,
+                                  window < n_rows, Q, use_lsh)
+
+    def _decode_top_n(self, top_scores, top_idx, hm: list[int],
+                      excl: list[set[str]], n_req: int, window_partial: bool,
+                      Q: np.ndarray,
+                      use_lsh: bool) -> list[list[tuple[str, float]]]:
+        """Host decode shared by the flat, streaming and sharded batched
+        paths: map rows to ids, drop excluded/retired rows, and retry a
+        request on the single-request path when its exclusions ate the
+        whole fetched window (only possible when the window was smaller
+        than the full item count)."""
         row_ids = self.Y.row_ids()
         results: list[list[tuple[str, float]]] = []
         for b in range(n_req):
@@ -553,10 +628,8 @@ class ALSServingModel(FactorModelBase, ServingModel):
                 out.append((id_, s))
                 if len(out) == hm[b]:
                     break
-            if len(out) < hm[b] and k < n_rows:
-                # this request's exclusions ate its window; redo with the
-                # same scan semantics on the single-request path
-                out = self.top_n(hm[b], user_vector=user_vectors[b],
+            if len(out) < hm[b] and window_partial:
+                out = self.top_n(hm[b], user_vector=Q[b],
                                  exclude=excl[b], use_lsh=use_lsh)
             results.append(out)
         return results

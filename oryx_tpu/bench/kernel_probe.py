@@ -1,0 +1,138 @@
+"""Device-exec timing for the serving scan kernels, tunnel-excluded.
+
+VERDICT r03: no artifact records kernel-only time for the grid cells,
+so device inefficiency, batching loss and tunnel latency cannot be told
+apart.  This probe isolates device execution on a transport where
+``block_until_ready`` is a no-op and a single dispatch+fetch pays a
+~100 ms round trip: it times one dispatch+fetch (rtt + exec) and a
+back-to-back queue of ``m`` dispatches fetched once (rtt + m*exec; the
+chip executes queued programs in order), and reports the difference.
+
+    exec = (t_m - t_1) / (m - 1)
+
+Also derives effective HBM scan bandwidth (bytes of item matrix per
+exec) — the number to compare against the chip's spec to decide whether
+a cell is bandwidth-bound or overhead-bound.
+
+Usage: python -m oryx_tpu.bench.kernel_probe --items 20 --features 250
+       [--lsh] [--batch 256]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+__all__ = ["probe_model", "time_exec"]
+
+
+def time_exec(dispatch, fetch, m: int = 6, reps: int = 3) -> dict:
+    """Median (rtt+exec) of one dispatch+fetch, and per-exec time from
+    an ``m``-deep dispatch queue.  ``dispatch()`` must enqueue one
+    device program and return its output handle(s) without blocking;
+    ``fetch(h)`` must block until that handle's program completed."""
+    fetch(dispatch())  # ensure compiled
+    t1s, tms = [], []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fetch(dispatch())
+        t1s.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        hs = [dispatch() for _ in range(m)]
+        fetch(hs[-1])
+        tms.append(time.perf_counter() - t0)
+    t1 = float(np.median(t1s))
+    tm = float(np.median(tms))
+    return {
+        "t1_ms": round(t1 * 1e3, 1),
+        "tm_ms": round(tm * 1e3, 1),
+        "m": m,
+        "exec_ms": round((tm - t1) / (m - 1) * 1e3, 2),
+    }
+
+
+def probe_model(model, batch: int = 256, how_many: int = 10,
+                m: int = 6) -> dict:
+    """Time the exact device programs the serving path dispatches for a
+    ``batch``-query drain on ``model``, excluding host and tunnel."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..app.als import serving_model as sm
+
+    vecs, active, version = model.Y.device_arrays_versioned()
+    n_rows = int(vecs.shape[0])
+    k = min(sm._pad_k(how_many), n_rows)
+    big, chunk = sm._stream_plan(n_rows, batch)
+    rng = np.random.default_rng(0)
+    Q = jnp.asarray(rng.standard_normal(
+        (batch, model.features)).astype(np.float32))
+    lsh_on = model._lsh_active()
+    buckets = model._cached_buckets(vecs, version) if lsh_on else None
+    hp = model.lsh._device_hyperplanes() if lsh_on else None
+    mb = model.lsh.max_bits_differing if lsh_on else 0
+    scan_bytes = n_rows * model.features * vecs.dtype.itemsize
+
+    out: dict = {
+        "items": n_rows, "features": model.features,
+        "batch": batch, "k": k, "lsh": lsh_on,
+        "streaming": bool(big), "chunk": chunk,
+        "scan_mb": round(scan_bytes / 1e6, 1),
+    }
+
+    def add(name, timing):
+        timing["effective_gb_per_s"] = round(
+            scan_bytes / max(timing["exec_ms"], 1e-9) / 1e6, 1)
+        timing["qps_ceiling"] = round(
+            batch / max(timing["exec_ms"], 1e-9) * 1e3, 1)
+        out[name] = timing
+
+    if big and n_rows % chunk == 0 and k <= chunk:
+        bs = sm._BLOCK_ROWS
+        ksel = min(sm._BLOCK_KSEL, n_rows // max(1, bs))
+        if n_rows % bs == 0 and 1 <= ksel < n_rows // bs and k <= ksel * bs:
+            add("twophase", time_exec(
+                lambda: sm._batch_top_n_twophase_kernel(
+                    vecs, Q, active, buckets, hp, k, chunk, bs, ksel, mb),
+                jax.device_get, m=m))
+        add("chunked_exact", time_exec(
+            lambda: sm._batch_top_n_chunked_kernel(
+                vecs, Q, active, buckets, hp, k, chunk, mb),
+            jax.device_get, m=m))
+    else:
+        if lsh_on:
+            add("flat_lsh", time_exec(
+                lambda: sm._batch_top_n_lsh_kernel(
+                    vecs, Q, active, buckets, hp, k, mb),
+                jax.device_get, m=m))
+        else:
+            add("flat", time_exec(
+                lambda: sm._batch_top_n_kernel(vecs, Q, active, k),
+                jax.device_get, m=m))
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--items", type=float, default=20.0,
+                    help="millions of items")
+    ap.add_argument("--features", type=int, default=250)
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--lsh", action="store_true")
+    ap.add_argument("--m", type=int, default=6)
+    args = ap.parse_args()
+
+    from .grid import build_model
+
+    rng = np.random.default_rng(7)
+    model, _ = build_model(args.features, int(args.items * 1e6), rng)
+    if not args.lsh:
+        model.lsh = None
+    print(json.dumps(probe_model(model, batch=args.batch, m=args.m)))
+
+
+if __name__ == "__main__":
+    main()

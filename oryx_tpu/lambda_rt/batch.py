@@ -15,6 +15,7 @@ import logging
 import threading
 import time
 
+from ..common import compile_cache
 from ..common.config import Config
 from ..common.lang import load_instance
 from ..kafka import utils as kafka_utils
@@ -56,6 +57,9 @@ class BatchLayer:
     def start(self) -> None:
         _log.info("Starting batch layer (generation interval %ds)",
                   self.generation_interval_sec)
+        # JVM-parity cold start: reload compiled XLA programs from disk
+        # instead of re-paying 100+ s of trainer compilation per restart
+        compile_cache.enable_from_config(self.config)
         # create the input topic at its configured partition count before
         # any lazy access can freeze it at one partition
         kafka_utils.maybe_create_topic(

@@ -228,8 +228,8 @@ class HttpApp:
 
     def _handle(self, handler: BaseHTTPRequestHandler) -> None:
         if not self._auth_ok(handler):
-            self._drain_body(handler)
             self._challenge(handler)
+            self._drain_body(handler)
             return
         parsed = urllib.parse.urlparse(handler.path)
         path = urllib.parse.unquote(parsed.path)
@@ -249,8 +249,8 @@ class HttpApp:
                 continue
             handler._oryx_route = f"{route.method} {route.pattern}"
             if route.mutates and self.read_only:
-                self._drain_body(handler)
                 self._send_error(handler, 403, "endpoint is read-only")
+                self._drain_body(handler)
                 return
             try:
                 length = int(handler.headers.get("Content-Length") or 0)
@@ -284,11 +284,11 @@ class HttpApp:
                        handler.headers.get("Accept", ""),
                        "gzip" in handler.headers.get("Accept-Encoding", ""))
             return
-        self._drain_body(handler)
         if matched_path:
             self._send_error(handler, 405, "method not allowed")
         else:
             self._send_error(handler, 404, f"no resource at {path}")
+        self._drain_body(handler)
 
     def _send(self, handler, result, head_only: bool, accept: str,
               gzip_ok: bool) -> None:
@@ -454,8 +454,8 @@ def make_server(app: HttpApp, port: int,
             if self.command in _KNOWN_METHODS:
                 app.handle(self)
             else:
-                app._drain_body(self)
                 app._send_error(self, 405, "method not allowed")
+                app._drain_body(self)
             self.wfile.flush()
             return not self._close
 

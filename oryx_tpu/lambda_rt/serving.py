@@ -15,6 +15,7 @@ import importlib
 import logging
 import threading
 
+from ..common import compile_cache
 from ..common.config import Config
 from ..common.lang import load_instance, logging_call
 from ..kafka import utils as kafka_utils
@@ -118,6 +119,9 @@ class ServingLayer:
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> None:
+        # JVM-parity cold start: warm_serving_kernels' per-bucket scan
+        # variants reload from the disk cache instead of recompiling
+        compile_cache.enable_from_config(self.config)
         if self.update_broker and self.update_topic:
             if not self.no_init_topics:
                 kafka_utils.maybe_create_topic(self.update_broker,

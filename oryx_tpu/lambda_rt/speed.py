@@ -12,6 +12,7 @@ from __future__ import annotations
 import logging
 import threading
 
+from ..common import compile_cache
 from ..common.config import Config
 from ..common.lang import load_instance, logging_call
 from ..kafka import utils as kafka_utils
@@ -45,6 +46,8 @@ class SpeedLayer:
     def start(self) -> None:
         _log.info("Starting speed layer (micro-batch %ds)",
                   self.generation_interval_sec)
+        # JVM-parity cold start: fold-in kernels reload from disk cache
+        compile_cache.enable_from_config(self.config)
         # create the input topic at its configured partition count before
         # any lazy access can freeze it at one partition
         kafka_utils.maybe_create_topic(

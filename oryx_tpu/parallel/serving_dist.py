@@ -77,6 +77,35 @@ def _make_kernel(mesh: Mesh, k_shard: int, k_final: int, axis: str):
     return jax.jit(scorer)
 
 
+class ShardKernelCache:
+    """Per-(k_shard, k_final) compiled SPMD merge kernels for one mesh —
+    the shard plan shared by :class:`ShardedItemScorer` and the serving
+    model's configured sharded mode (``oryx.serving.api.item-shards``)."""
+
+    def __init__(self, mesh: Mesh, axis: str = "d"):
+        self.mesh = mesh
+        self.axis = axis
+        self._kernels: dict[tuple[int, int], object] = {}
+
+    def top_k(self, Y, active, Q_dev, k: int):
+        """(scores, global_row_idx) of the merged per-shard top-k for a
+        replicated query batch; ``k`` is clamped to the global row
+        count and each shard's contribution to its local rows."""
+        n_rows = int(Y.shape[0])
+        n_local = n_rows // self.mesh.devices.size
+        k_shard = min(k, n_local)
+        k_final = min(k, k_shard * self.mesh.devices.size)
+        kern = self._kernels.get((k_shard, k_final))
+        if kern is None:
+            kern = self._kernels[(k_shard, k_final)] = _make_kernel(
+                self.mesh, k_shard, k_final, self.axis)
+        return kern(Y, active, Q_dev)
+
+    def replicate(self, Q: np.ndarray):
+        return jax.device_put(
+            Q, NamedSharding(self.mesh, P(None, None)))
+
+
 class ShardedItemScorer:
     """Row-sharded item matrix + batched exact top-N over a mesh.
 
@@ -104,7 +133,7 @@ class ShardedItemScorer:
         self._Y = jax.device_put(padded, row)
         self._active = jax.device_put(active, row)
         self.features = int(Y.shape[1])
-        self._kernels: dict[tuple[int, int], object] = {}
+        self._kernels = ShardKernelCache(mesh, axis)
 
     def __len__(self) -> int:
         return len(self._ids)
@@ -121,24 +150,13 @@ class ShardedItemScorer:
         n_req = Q.shape[0]
         if n_req == 0:
             return []
-        n_local = int(self._Y.shape[0]) // self.mesh.devices.size
-        # each shard contributes at most its own rows; the merged width
-        # clamps to the GLOBAL row count so how_many > rows-per-shard
-        # still returns full lists (every shard ships its whole top)
-        k_shard = min(_pad_k(how_many), n_local)
-        k_final = min(_pad_k(how_many),
-                      k_shard * self.mesh.devices.size)
         b_pad = _pad_k(n_req)
         if b_pad != n_req:
             Q = np.concatenate(
                 [Q, np.zeros((b_pad - n_req, Q.shape[1]), np.float32)])
-        kern = self._kernels.get((k_shard, k_final))
-        if kern is None:
-            kern = self._kernels[(k_shard, k_final)] = _make_kernel(
-                self.mesh, k_shard, k_final, self.axis)
-        scores, idx = jax.device_get(
-            kern(self._Y, self._active,
-                 jax.device_put(Q, NamedSharding(self.mesh, P(None, None)))))
+        scores, idx = jax.device_get(self._kernels.top_k(
+            self._Y, self._active, self._kernels.replicate(Q),
+            min(_pad_k(how_many), int(self._Y.shape[0]))))
         out: list[list[tuple[str, float]]] = []
         for b in range(n_req):
             row: list[tuple[str, float]] = []

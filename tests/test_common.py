@@ -254,3 +254,44 @@ def test_strip_scheme():
 def test_choose_free_port():
     p = io_utils.choose_free_port()
     assert 0 < p < 65536
+
+
+def test_compile_cache_enable_from_config(tmp_path, monkeypatch):
+    import jax
+
+    from oryx_tpu.common import compile_cache
+    from oryx_tpu.common.config import from_dict
+
+    prev = jax.config.jax_compilation_cache_dir
+    monkeypatch.setattr(compile_cache, "_enabled_dir", None)
+    # JAX memoizes the cache instance at first use; earlier tests that
+    # started layers may have initialized it at the default path
+    from jax._src import compilation_cache as _cc
+
+    _cc.reset_cache()
+    try:
+        cc = str(tmp_path / "cc")
+        cfg = from_dict({"oryx.compile-cache-dir": cc,
+                         "oryx.compile-cache-min-compile-secs": 0.0})
+        assert compile_cache.enable_from_config(cfg) == cc
+        assert jax.config.jax_compilation_cache_dir == cc
+        # first configuration wins process-wide
+        cfg2 = from_dict({"oryx.compile-cache-dir": "/elsewhere"})
+        assert compile_cache.enable_from_config(cfg2) == cc
+        # a compiled executable lands on disk
+        f = jax.jit(lambda x: x * 2 + 1)
+        assert float(f(jax.numpy.float32(3))) == 7.0
+        import pathlib
+        assert list(pathlib.Path(cc).iterdir())
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
+        _cc.reset_cache()
+
+
+def test_compile_cache_disabled_when_null(monkeypatch):
+    from oryx_tpu.common import compile_cache
+    from oryx_tpu.common.config import from_dict
+
+    monkeypatch.setattr(compile_cache, "_enabled_dir", None)
+    cfg = from_dict({"oryx.compile-cache-dir": None})
+    assert compile_cache.enable_from_config(cfg) is None

@@ -1,0 +1,195 @@
+"""Interop against the reference's ACTUAL wire format: hand-constructed
+JPMML-4.3 documents exactly as the reference writers marshal them
+(namespace http://www.dmg.org/PMML-4_3, Extensions placed last in
+document order per the JAXB propOrder, JPMML attribute spellings).
+
+Fixture provenance (structure, not bytes):
+ - ALS:      ALSUpdate.mfModelToPMML (ALSUpdate.java:430-473) —
+             X/Y path, features/lambda/implicit/alpha/logStrength/
+             epsilon value-Extensions, XIDs/YIDs content-Extensions
+             with PMML space-delimited quoting.
+ - RDF:      RDFUpdate.rdfModelToPMML/toTreeModel (RDFUpdate.java:
+             368-521) — MiningModel+Segmentation for forests, bare
+             TreeModel for one tree, r/+/- node ids, greaterThan
+             predicates, isNotIn SimpleSetPredicate, defaultChild,
+             ScoreDistribution with confidence, MiningField importance.
+ - k-means:  KMeansUpdate.kMeansModelToPMML (KMeansUpdate.java:
+             184-230) — centerBased ClusteringModel, squaredEuclidean
+             ComparisonMeasure, isCenterField ClusteringFields,
+             Cluster size + real Array with n.
+"""
+
+import math
+import os
+
+import pytest
+
+from oryx_tpu.common import pmml as pmml_io
+from oryx_tpu.common.config import from_dict
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+def _fixture(name):
+    return pmml_io.read(os.path.join(FIXTURES, name))
+
+
+# -- ALS ---------------------------------------------------------------------
+
+def test_reads_jpmml_als_extensions():
+    doc = _fixture("jpmml_als.pmml.xml")
+    assert pmml_io.get_extension_value(doc, "X") == "X/"
+    assert pmml_io.get_extension_value(doc, "Y") == "Y/"
+    assert int(pmml_io.get_extension_value(doc, "features")) == 3
+    assert float(pmml_io.get_extension_value(doc, "lambda")) == 0.001
+    assert pmml_io.get_extension_value(doc, "implicit") == "true"
+    assert float(pmml_io.get_extension_value(doc, "alpha")) == 1.0
+    assert pmml_io.get_extension_value(doc, "logStrength") == "true"
+    assert float(pmml_io.get_extension_value(doc, "epsilon")) == 0.01
+    # quoted IDs use the PMML space-delimited convention
+    # (TextUtils.joinPMMLDelimited)
+    assert pmml_io.get_extension_content(doc, "XIDs") == \
+        ["u0", "u1", "user two", "u3"]
+    assert pmml_io.get_extension_content(doc, "YIDs") == \
+        ["i0", "item one", "i2"]
+
+
+def test_own_als_writer_round_trips_jpmml_structure():
+    doc = pmml_io.build_skeleton_pmml()
+    pmml_io.add_extension(doc, "X", "X/")
+    pmml_io.add_extension(doc, "features", 3)
+    pmml_io.add_extension(doc, "implicit", True)
+    pmml_io.add_extension_content(doc, "XIDs", ["u0", "user two"])
+    reparsed = pmml_io.from_string(pmml_io.to_string(doc))
+    assert pmml_io.get_extension_value(reparsed, "features") == "3"
+    assert pmml_io.get_extension_value(reparsed, "implicit") == "true"
+    assert pmml_io.get_extension_content(reparsed, "XIDs") == \
+        ["u0", "user two"]
+
+
+# -- RDF ---------------------------------------------------------------------
+
+def _rdf_schema(feature_names, numeric, categorical, target):
+    return __import__(
+        "oryx_tpu.app.schema", fromlist=["InputSchema"]).InputSchema(
+        from_dict({"oryx.input-schema": {
+            "feature-names": feature_names,
+            "numeric-features": numeric,
+            "categorical-features": categorical,
+            "target-feature": target,
+        }}))
+
+
+def test_reads_jpmml_rdf_forest():
+    from oryx_tpu.app.classreg import Example
+    from oryx_tpu.app.rdf.pmml import read_forest, validate_pmml_vs_schema
+
+    doc = _fixture("jpmml_rdf_classification.pmml.xml")
+    schema = _rdf_schema(["age", "fruit", "color"], ["age"],
+                         ["fruit", "color"], "color")
+    validate_pmml_vs_schema(doc, schema)
+    forest, encodings = read_forest(doc)
+
+    assert len(forest.trees) == 2
+    assert list(forest.weights) == [1.0, 1.0]
+    # importances ride MiningField order
+    assert list(forest.feature_importances[:2]) == [0.75, 0.25]
+    # DataDictionary Value order defines the encodings
+    assert encodings.get_value_encoding_map(2) == {"red": 0, "green": 1}
+    assert encodings.get_value_encoding_map(1) == \
+        {"apple": 0, "banana": 1, "cherry": 2}
+
+    # tree 1: age > 30.5 routes right to the red-heavy leaf
+    t1 = forest.trees[0]
+    old = t1.find_terminal(Example(None, [45.0, 0, None]))
+    assert old.id == "r+"
+    assert list(old.prediction.category_counts) == [36.0, 4.0]
+    young = t1.find_terminal(Example(None, [20.0, 0, None]))
+    assert young.id == "r-"
+
+    # tree 2: isNotIn {banana, cherry} selects apples rightward, then
+    # age > 10 picks the deeper leaf
+    t2 = forest.trees[1]
+    apple_old = t2.find_terminal(Example(None, [12.0, 0, None]))
+    assert apple_old.id == "r++"
+    banana = t2.find_terminal(Example(None, [12.0, 1, None]))
+    assert banana.id == "r-"
+
+    # defaultChild drives the missing-value route (tree 1: r- default)
+    missing = t1.find_terminal(Example(None, [None, 0, None]))
+    assert missing.id == "r-"
+
+
+def test_reads_jpmml_rdf_regression_tree():
+    from oryx_tpu.app.classreg import Example
+    from oryx_tpu.app.rdf.pmml import read_forest, validate_pmml_vs_schema
+
+    doc = _fixture("jpmml_rdf_regression.pmml.xml")
+    schema = _rdf_schema(["sqft", "rooms", "price"], ["sqft", "rooms",
+                         "price"], None, "price")
+    validate_pmml_vs_schema(doc, schema)
+    forest, _ = read_forest(doc)
+    assert len(forest.trees) == 1
+    big = forest.trees[0].find_terminal(Example(None, [2000.0, 3.0, None]))
+    assert big.prediction.prediction == 400000.0
+    small = forest.trees[0].find_terminal(Example(None, [900.0, 2.0, None]))
+    assert small.prediction.prediction == 250000.0
+    # greaterThan boundary: exactly 1500.0 is NOT greater -> left child
+    edge = forest.trees[0].find_terminal(Example(None, [1500.0, 2.0, None]))
+    assert edge.prediction.prediction == 250000.0
+
+
+def test_own_rdf_writer_round_trips_jpmml_structure():
+    from oryx_tpu.app.classreg import Example
+    from oryx_tpu.app.rdf.pmml import forest_to_pmml, read_forest, \
+        validate_pmml_vs_schema
+
+    doc = _fixture("jpmml_rdf_classification.pmml.xml")
+    schema = _rdf_schema(["age", "fruit", "color"], ["age"],
+                         ["fruit", "color"], "color")
+    forest, encodings = read_forest(doc)
+    rewritten = pmml_io.from_string(pmml_io.to_string(
+        forest_to_pmml(forest, schema, encodings, max_depth=8,
+                       max_split_candidates=100, impurity="entropy")))
+    validate_pmml_vs_schema(rewritten, schema)
+    forest2, _ = read_forest(rewritten)
+    assert pmml_io.get_extension_value(rewritten, "impurity") == "entropy"
+    for age, fruit in [(45.0, 0), (20.0, 0), (12.0, 1), (5.0, 2)]:
+        ex = Example(None, [age, fruit, None])
+        for t1, t2 in zip(forest.trees, forest2.trees):
+            assert t1.find_terminal(ex).id == \
+                t2.find_terminal(ex).id
+
+
+# -- k-means -----------------------------------------------------------------
+
+def test_reads_jpmml_kmeans_clusters():
+    from oryx_tpu.app.kmeans.pmml import read_clusters, \
+        validate_pmml_vs_schema
+
+    doc = _fixture("jpmml_kmeans.pmml.xml")
+    schema = _rdf_schema(["x0", "x1", "x2"], ["x0", "x1", "x2"], None,
+                         None)
+    validate_pmml_vs_schema(doc, schema)
+    clusters = read_clusters(doc)
+    assert [c.id for c in clusters] == [0, 1, 2]
+    assert [c.count for c in clusters] == [1200, 800, 2000]
+    assert list(clusters[0].center) == [-1.5, 0.25, 3.0]
+    assert list(clusters[2].center) == [0.0, 4.5, -2.25]
+
+
+def test_own_kmeans_writer_round_trips_jpmml_structure():
+    from oryx_tpu.app.kmeans.common import ClusterInfo
+    from oryx_tpu.app.kmeans.pmml import clusters_to_pmml, read_clusters, \
+        validate_pmml_vs_schema
+
+    schema = _rdf_schema(["x0", "x1", "x2"], ["x0", "x1", "x2"], None,
+                         None)
+    clusters = [ClusterInfo(0, [1.0, -2.0, 0.5], 10),
+                ClusterInfo(1, [0.0, 3.25, -1.0], 20)]
+    doc = pmml_io.from_string(pmml_io.to_string(
+        clusters_to_pmml(clusters, schema)))
+    validate_pmml_vs_schema(doc, schema)
+    back = read_clusters(doc)
+    assert [(c.id, list(c.center), c.count) for c in back] == \
+        [(0, [1.0, -2.0, 0.5], 10), (1, [0.0, 3.25, -1.0], 20)]
