@@ -66,9 +66,21 @@ _MAX_CHUNK_ROWS = 1 << 17
 _CHUNKED_BATCH = 256
 
 
+def _q_cast(Q, Y):
+    """Match the query operand's dtype to a bfloat16-stored factor
+    matrix.  A mixed f32 x bf16 matmul promotes BOTH operands to f32
+    and runs at the MXU's f32 rate (~1/4 of bf16); casting the query
+    keeps the scan on the native bf16 path with f32 accumulation
+    (kernel-only timings per cell: BENCH_GRID_r04.json device_exec_ms).
+    Score precision is unchanged in substance:
+    the factors are already bf16-quantized in HBM, and products of two
+    bf16 values are exact in the f32 accumulator."""
+    return Q.astype(Y.dtype) if Y.dtype == jnp.bfloat16 else Q
+
+
 @jax.jit
 def _dot_scores(Y, x):
-    return jnp.matmul(Y, x, preferred_element_type=jnp.float32)
+    return jnp.matmul(Y, _q_cast(x, Y), preferred_element_type=jnp.float32)
 
 
 @jax.jit
@@ -110,7 +122,8 @@ def _batch_top_n_kernel(Y, Q, active, k: int):
     masked top-k per row.  This is the serving-time request batcher's
     kernel (SURVEY §2.14 P6: Tomcat's 400-thread fan-out becomes one
     MXU matmul over the batched queries)."""
-    scores = jnp.matmul(Q, Y.T, preferred_element_type=jnp.float32)
+    scores = jnp.matmul(_q_cast(Q, Y), Y.T,
+                        preferred_element_type=jnp.float32)
     scores = jnp.where(active[None, :], scores, -jnp.inf)
     return jax.lax.top_k(scores, k)
 
@@ -124,7 +137,8 @@ def _batch_top_n_lsh_kernel(Y, Q, active, buckets, hyperplanes,
     (reference scans selected partitions on a thread pool instead,
     ALSServingModel.java:265-280)."""
     target = _query_buckets(Q, hyperplanes)
-    scores = jnp.matmul(Q, Y.T, preferred_element_type=jnp.float32)
+    scores = jnp.matmul(_q_cast(Q, Y), Y.T,
+                        preferred_element_type=jnp.float32)
     ok = _lsh_ok(active[None, :], buckets[None, :], target[:, None],
                  max_bits)
     return jax.lax.top_k(jnp.where(ok, scores, -jnp.inf), k)
@@ -185,8 +199,11 @@ def _batch_top_n_twophase_kernel(Y, Q, active, buckets, hyperplanes,
         xs = xs + (buckets.reshape(n_chunks, chunk),)
         target = _query_buckets(Q, hyperplanes)
 
+    Qc = _q_cast(Q, Y)
+
     def step_a(_, x):
-        scores = jnp.matmul(Q, x[0].T, preferred_element_type=jnp.float32)
+        scores = jnp.matmul(Qc, x[0].T,
+                            preferred_element_type=jnp.float32)
         ok = x[1][None, :]
         if target is not None:
             ok = _lsh_ok(ok, x[2][None, :], target[:, None], max_bits)
@@ -197,9 +214,14 @@ def _batch_top_n_twophase_kernel(Y, Q, active, buckets, hyperplanes,
     M = jnp.transpose(Ms, (1, 0, 2)).reshape(b, -1)   # (B, n_blocks)
     _, bi = jax.lax.approx_max_k(M, ksel, recall_target=_APPROX_RECALL)
     m_rest = M.at[jnp.arange(b)[:, None], bi].set(-jnp.inf).max(-1)
+    # gathered blocks stay in the store dtype: phase B must reduce the
+    # SAME bf16 products phase A did or the exactness certificate's
+    # phase-A-bounds-phase-B argument breaks at the rounding margin
     Yg = jnp.take(Y.reshape(-1, bs, Y.shape[1]), bi,
-                  axis=0).astype(jnp.float32)          # (B, ksel, bs, F)
-    scores = jnp.einsum("bf,bkcf->bkc", Q, Yg).reshape(b, ksel * bs)
+                  axis=0)                              # (B, ksel, bs, F)
+    scores = jnp.einsum("bf,bkcf->bkc", Qc, Yg,
+                        preferred_element_type=jnp.float32
+                        ).reshape(b, ksel * bs)
     ok = jnp.take(active.reshape(-1, bs), bi, axis=0).reshape(b, ksel * bs)
     if target is not None:
         bg = jnp.take(buckets.reshape(-1, bs), bi,
@@ -232,10 +254,13 @@ def _batch_top_n_chunked_kernel(Y, Q, active, buckets, hyperplanes,
         xs = xs + (buckets.reshape(n_chunks, chunk),)
         target = _query_buckets(Q, hyperplanes)
 
+    Qc = _q_cast(Q, Y)
+
     def step(carry, x):
         best_s, best_i = carry
         Yc, Ac, base = x[:3]
-        scores = jnp.matmul(Q, Yc.T, preferred_element_type=jnp.float32)
+        scores = jnp.matmul(Qc, Yc.T,
+                            preferred_element_type=jnp.float32)
         ok = Ac[None, :]
         if target is not None:
             ok = _lsh_ok(ok, x[3][None, :], target[:, None], max_bits)

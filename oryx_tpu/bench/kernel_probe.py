@@ -84,10 +84,18 @@ def probe_model(model, batch: int = 256, how_many: int = 10,
     }
 
     def add(name, timing):
-        timing["effective_gb_per_s"] = round(
-            scan_bytes / max(timing["exec_ms"], 1e-9) / 1e6, 1)
-        timing["qps_ceiling"] = round(
-            batch / max(timing["exec_ms"], 1e-9) * 1e3, 1)
+        if timing["exec_ms"] <= 0:
+            # tunnel jitter swallowed the m-queue delta (small kernels:
+            # m*exec inside the ~100 ms RTT variance) — flag rather
+            # than emit absurd derived numbers
+            timing["unmeasurable"] = True
+            timing["effective_gb_per_s"] = None
+            timing["qps_ceiling"] = None
+        else:
+            timing["effective_gb_per_s"] = round(
+                scan_bytes / timing["exec_ms"] / 1e6, 1)
+            timing["qps_ceiling"] = round(
+                batch / timing["exec_ms"] * 1e3, 1)
         out[name] = timing
 
     if big and n_rows % chunk == 0 and k <= chunk:

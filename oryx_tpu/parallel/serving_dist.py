@@ -31,7 +31,7 @@ except ImportError:  # pragma: no cover - older JAX
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..app.als.feature_vectors import resolve_dtype
-from ..app.als.serving_model import _pad_k
+from ..app.als.serving_model import _pad_k, _q_cast
 
 __all__ = ["ShardedItemScorer"]
 
@@ -59,7 +59,9 @@ def _make_kernel(mesh: Mesh, k_shard: int, k_final: int, axis: str):
              **_shardmap_norepcheck_kwargs())
     def scorer(Y_local, active_local, Q):
         n_local = Y_local.shape[0]
-        scores = jnp.matmul(Q, Y_local.T,
+        # bf16 stores: keep the scan on the native bf16 MXU path
+        # (serving_model._q_cast rationale)
+        scores = jnp.matmul(_q_cast(Q, Y_local), Y_local.T,
                             preferred_element_type=jnp.float32)
         scores = jnp.where(active_local[None, :], scores, -jnp.inf)
         ls, li = jax.lax.top_k(scores, k_shard)        # (B, ks) local

@@ -6,13 +6,16 @@ single request out across cores (ServingLayer.java:235); the TPU-native
 inversion batches many concurrent requests into ONE MXU matmul
 (`ALSServingModel.top_n_batch`).
 
-Design: adaptive queue-drain batching with service-rate pacing.
-Handler threads enqueue a scoring job and block; dispatcher threads
-drain whatever is queued and issue one batched kernel call each.  An
-idle server dispatches a lone request immediately (no artificial
-delay), but once a dispatch is in flight, further drains are PACED at
-the device's measured service rate (the EWMA of completion gaps while
-the device is busy).  Pacing is what makes batching adapt to model
+Design: adaptive queue-drain batching with service-rate pacing on
+QUEUE AGE.  Handler threads enqueue a scoring job and block;
+dispatcher threads drain whatever is queued and issue one batched
+kernel call each.  An idle server holds a request only a small
+fraction of one service time (sub-millisecond on small models) so a
+synchronized burst coalesces; once a dispatch is in flight, further
+drains are PACED at the device's measured service rate (the EWMA of
+completion gaps while the device is busy), measured from the oldest
+pending arrival so a stale last-dispatch timestamp after an idle gap
+cannot trigger a tiny drain.  Pacing is what makes batching adapt to model
 size: a 20M-item scan takes ~100x longer per dispatch than a 1M scan,
 and without pacing the free dispatchers would instantly shred the queue
 into tiny batches that serialize on the device (observed: a 5M-item
@@ -41,7 +44,7 @@ _MAX_EXEC_S = 5.0
 
 class _Job:
     __slots__ = ("model", "how_many", "vector", "exclude", "done",
-                 "result", "error")
+                 "result", "error", "t_enq")
 
     def __init__(self, model, how_many: int, vector: np.ndarray,
                  exclude: set[str]):
@@ -52,6 +55,7 @@ class _Job:
         self.done = threading.Event()
         self.result: list[tuple[str, float]] | None = None
         self.error: BaseException | None = None
+        self.t_enq = time.monotonic()
 
 
 class TopNBatcher:
@@ -161,19 +165,35 @@ class TopNBatcher:
         while True:
             with self._cond:
                 while not self._stopped:
-                    if self._pending and self._in_flight == 0:
-                        break
-                    if self._pending \
-                            and self._in_flight < self._in_flight_target():
-                        since = time.monotonic() - self._last_dispatch
-                        if (len(self._pending) >= self.max_batch
-                                or since >= self._exec_ewma):
-                            break
-                        # pace: wait out the rest of one service
-                        # interval so arrivals coalesce into this drain
-                        self._cond.wait(self._exec_ewma - since)
-                    else:
+                    if not self._pending:
                         self._cond.wait()
+                        continue
+                    # Pace on QUEUE AGE, not time since the last
+                    # dispatch: after an idle gap, "since last dispatch"
+                    # is stale and a dispatcher would fire with the
+                    # first few trickled-in arrivals — each tiny drain
+                    # still pays a full fixed-size scan window on big
+                    # models (measured: mean drains of ~8 while the 20M
+                    # cells' window serves 256, a ~6x throughput loss).
+                    age = time.monotonic() - self._pending[0].t_enq
+                    full = len(self._pending) >= self.max_batch
+                    if self._in_flight == 0:
+                        # device idle: wait only a small fraction of a
+                        # service time, so a burst coalesces but a lone
+                        # request on a cheap model goes ~immediately
+                        wait = min(0.02, self._exec_ewma / 8) - age
+                    elif self._in_flight < self._in_flight_target():
+                        # device busy: coalesce one service interval
+                        wait = self._exec_ewma - age
+                    else:
+                        # at the in-flight cap: a full queue must NOT
+                        # add dispatches — extra depth only stacks
+                        # device-queue latency onto every later request
+                        self._cond.wait()
+                        continue
+                    if full or wait <= 0:
+                        break
+                    self._cond.wait(wait)
                 if self._stopped:
                     jobs, self._pending = self._pending, []
                 else:
