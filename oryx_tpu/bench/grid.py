@@ -138,6 +138,7 @@ def bench_config(features: int, items_m: int, model, user_ids,
         port = server.server_address[1]
         threading.Thread(target=server.serve_forever, daemon=True).start()
         base = f"http://127.0.0.1:{port}"
+        fallbacks_at_start = model.twophase_fallbacks
         try:
             # compile warm-up: every pow2 drain-size bucket the batcher
             # can produce at the load driver's how_many (same top_k
@@ -177,8 +178,8 @@ def bench_config(features: int, items_m: int, model, user_ids,
             batcher.close()
         base_qps, base_lat = BASELINES[(features, items_m, lsh_on)]
         kernel_path = next((p for p in
-                            ("twophase", "flat_lsh", "flat",
-                             "chunked_exact") if p in probe), None)
+                            ("twophase_pallas", "twophase", "flat_lsh",
+                             "flat", "chunked_exact") if p in probe), None)
         kern = probe.get(kernel_path, {})
         rows.append({
             "features": features,
@@ -201,6 +202,11 @@ def bench_config(features: int, items_m: int, model, user_ids,
                 low["p50_ms"] - tunnel_floor_ms, 1),
             "device_mb": round(device_bytes(model) / 1e6, 1),
             "batcher": batcher_stats,
+            # exact-scan recomputes forced by failed two-phase
+            # certificates during THIS cell's run (delta against the
+            # cumulative model counter; expected 0)
+            "twophase_fallbacks": model.twophase_fallbacks
+            - fallbacks_at_start,
         })
         print(json.dumps(rows[-1]), flush=True)
     model.lsh = lsh_obj

@@ -15,7 +15,7 @@ exec) — the number to compare against the chip's spec to decide whether
 a cell is bandwidth-bound or overhead-bound.
 
 Usage: python -m oryx_tpu.bench.kernel_probe --items 20 --features 250
-       [--lsh] [--batch 256]
+       [--lsh off|on|both] [--batch 256]
 """
 
 from __future__ import annotations
@@ -106,6 +106,16 @@ def probe_model(model, batch: int = 256, how_many: int = 10,
                 lambda: sm._batch_top_n_twophase_kernel(
                     vecs, Q, active, buckets, hp, k, chunk, bs, ksel, mb),
                 jax.device_get, m=m))
+            if n_rows % sm._PA_TILE == 0:
+                penalty = model._cached_penalty(active, version)
+                try:
+                    add("twophase_pallas", time_exec(
+                        lambda: sm._batch_top_n_twophase_pallas(
+                            vecs, Q, penalty, active, buckets, hp, k,
+                            bs, ksel, mb),
+                        jax.device_get, m=m))
+                except Exception as e:  # noqa: BLE001 — backend-dependent
+                    out["twophase_pallas_error"] = str(e)[:160]
         add("chunked_exact", time_exec(
             lambda: sm._batch_top_n_chunked_kernel(
                 vecs, Q, active, buckets, hp, k, chunk, mb),
@@ -129,7 +139,8 @@ def main() -> None:
                     help="millions of items")
     ap.add_argument("--features", type=int, default=250)
     ap.add_argument("--batch", type=int, default=256)
-    ap.add_argument("--lsh", action="store_true")
+    ap.add_argument("--lsh", choices=["off", "on", "both"],
+                    default="off")
     ap.add_argument("--m", type=int, default=6)
     args = ap.parse_args()
 
@@ -137,9 +148,15 @@ def main() -> None:
 
     rng = np.random.default_rng(7)
     model, _ = build_model(args.features, int(args.items * 1e6), rng)
-    if not args.lsh:
+    lsh_obj = model.lsh
+    if args.lsh in ("off", "both"):
         model.lsh = None
-    print(json.dumps(probe_model(model, batch=args.batch, m=args.m)))
+        print(json.dumps(probe_model(model, batch=args.batch, m=args.m)),
+              flush=True)
+    if args.lsh in ("on", "both"):
+        model.lsh = lsh_obj
+        print(json.dumps(probe_model(model, batch=args.batch, m=args.m)),
+              flush=True)
 
 
 if __name__ == "__main__":

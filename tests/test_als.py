@@ -709,3 +709,73 @@ def test_top_n_batch_twophase_cert_fallback(monkeypatch):
     assert model.twophase_fallbacks >= 1
     for f, c in zip(want, got):
         assert [i for i, _ in f] == [i for i, _ in c]
+
+
+def test_pallas_phase_a_interpret_agrees_with_scan_kernel():
+    """The pallas-built two-phase program (interpret mode, so it runs on
+    the CPU test platform) must produce the same top-k as the lax.scan
+    build — same phase B, same certificate semantics."""
+    import jax
+    import jax.numpy as jnp
+
+    from oryx_tpu.app.als import serving_model as sm
+
+    rng = np.random.default_rng(11)
+    n, f, b, k = 8192, 16, 8, 8
+    Y = jnp.asarray(rng.standard_normal((n, f)).astype(np.float32))
+    Q = jnp.asarray(rng.standard_normal((b, f)).astype(np.float32))
+    act = np.ones(n, bool)
+    act[::5] = False
+    active = jnp.asarray(act)
+    penalty = sm._penalty_kernel(active)
+    bs, ksel = 128, 8
+    chunk = 2048
+    old_tile = sm._PA_TILE
+    sm._PA_TILE = 2048
+    try:
+        ts_p, ti_p, cert_p = jax.device_get(
+            sm._batch_top_n_twophase_pallas(
+                Y, Q, penalty, active, None, None, k, bs, ksel, 0,
+                interpret=True))
+    finally:
+        sm._PA_TILE = old_tile
+    ts_s, ti_s, cert_s = jax.device_get(
+        sm._batch_top_n_twophase_kernel(
+            Y, Q, active, None, None, k, chunk, bs, ksel, 0))
+    np.testing.assert_allclose(ts_p, ts_s, rtol=1e-5)
+    assert (ti_p == ti_s).all()
+    assert (cert_p == cert_s).all()
+
+
+def test_pallas_fallback_on_unsupported_backend():
+    """On the CPU test platform the non-interpret pallas path cannot
+    lower; the dispatcher must fall back to the scan kernel and still
+    answer correctly (and permanently, without raising)."""
+    from oryx_tpu.app.als import serving_model as sm
+
+    rng = np.random.default_rng(3)
+    model = ALSServingModel(features=6, implicit=True)
+    model.Y.bulk_load([f"i{j}" for j in range(4096)],
+                      rng.standard_normal((4096, 6)).astype(np.float32))
+    q = rng.standard_normal((3, 6)).astype(np.float32)
+    old_state = dict(sm._PALLAS_STATE)
+    old_limits = (sm._FLAT_SCORES_LIMIT, sm._MAX_CHUNK_ROWS,
+                  sm._BLOCK_KSEL, sm._PA_TILE)
+    import jax  # noqa: F401 — device_get in the exercised path
+    sm._PALLAS_STATE.clear()
+    sm._FLAT_SCORES_LIMIT = 1
+    sm._MAX_CHUNK_ROWS = 1024
+    sm._BLOCK_KSEL = 4
+    sm._PA_TILE = 1024
+    try:
+        got = model.top_n_batch(5, q)
+        want = [model.top_n(5, user_vector=v) for v in q]
+        for g, w in zip(got, want):
+            assert [i for i, _ in g] == [i for i, _ in w]
+        assert set(sm._PALLAS_STATE.values()) <= {"ok", "broken"}
+        assert sm._PALLAS_STATE  # the dispatcher recorded a verdict
+    finally:
+        sm._PALLAS_STATE.clear()
+        sm._PALLAS_STATE.update(old_state)
+        (sm._FLAT_SCORES_LIMIT, sm._MAX_CHUNK_ROWS,
+         sm._BLOCK_KSEL, sm._PA_TILE) = old_limits
