@@ -24,6 +24,7 @@ speed.
 
 from __future__ import annotations
 
+import logging
 import math
 import threading
 from functools import partial
@@ -40,6 +41,8 @@ from .lsh import LocalitySensitiveHash, _popcount
 from .rescorer import Rescorer
 
 __all__ = ["ALSServingModel", "SolverCache"]
+
+_log = logging.getLogger(__name__)
 
 
 def _pad_k(k: int) -> int:
@@ -160,12 +163,21 @@ def _stream_plan(n_rows: int, b_pad: int) -> tuple[bool, int]:
 
 # Two-phase streaming top-k tuning: 128-row blocks match the TPU's
 # lane granularity (a block gather moves aligned ~13-64 KB slabs, not
-# sub-tile rows), and recall 0.999 on the block-selection approx_max_k
-# makes the exactness certificate pass >99.99% of dispatches on random
-# factors while staying ~8x faster than an exact lax.top_k scan.
+# sub-tile rows).  The block-selection approx_max_k's RECALL sets the
+# certificate-failure rate directly: at recall 0.999 over the 20M
+# cells' 157k block maxima, ~15% of 256-query windows had one row
+# whose head block was genuinely missed (diagnosed: pallas kth 37.068
+# vs exact 37.223 — a real miss the certificate caught, not a rounding
+# artifact), and every failure recomputes a window on the ~10x slower
+# exact scan.  Recall 0.99999 makes misses ~100x rarer; the partial
+# reduce is still far cheaper than an exact lax.top_k over the maxima
+# (the ~40x-the-matmul per-row sort the design exists to avoid).
+# Widening ksel does NOT help — a missed head block stays missed no
+# matter how many other blocks are selected (measured: ksel 64 still
+# failed 6 of 40 windows at recall 0.999).
 _BLOCK_ROWS = 128
 _BLOCK_KSEL = 32
-_APPROX_RECALL = 0.999
+_APPROX_RECALL = 0.99999
 
 
 def _phase_b(Y, Qc, active, buckets, target, M, k: int, bs: int,
@@ -197,7 +209,15 @@ def _phase_b(Y, Qc, active, buckets, target, M, k: int, bs: int,
             + jnp.arange(bs, dtype=jnp.int32)[None, None, :]).reshape(
                 b, ksel * bs)
     idx = jnp.take_along_axis(rows, ti, axis=1)
-    cert = ts[:, k - 1] >= m_rest
+    # conservative margin: phase A (MXU dot, per-tile accumulation) and
+    # phase B (einsum) may round the same bf16 products differently by
+    # ~F*ulp; inflating m_rest by a relative epsilon can only FAIL the
+    # certificate more often (never pass a true miss), preserving
+    # exactness under cross-kernel accumulation-order divergence
+    # (relative only: zero-padded batch rows score exactly 0 on both
+    # phases and must keep passing)
+    m_guard = m_rest + jnp.abs(m_rest) * 1e-4
+    cert = ts[:, k - 1] >= m_guard
     return ts, idx, cert
 
 
@@ -734,7 +754,7 @@ class ALSServingModel(FactorModelBase, ServingModel):
         cannot lower (plain CPU) or on any compile failure."""
         n_rows = int(vecs.shape[0])
         key = (n_rows, int(vecs.shape[1]), int(windows[0].shape[0]),
-               buckets is not None, k)
+               str(vecs.dtype), buckets is not None, k, mb)
         if _PALLAS_STATE.get(key) != "broken" and n_rows % _PA_TILE == 0:
             penalty = self._cached_penalty(active, version)
             try:
@@ -745,10 +765,14 @@ class ALSServingModel(FactorModelBase, ServingModel):
                     for qw in windows])
                 _PALLAS_STATE[key] = "ok"
                 return out
-            except Exception:  # noqa: BLE001 — any lowering/compile error
+            except Exception as e:  # noqa: BLE001 — lowering/compile error
                 if _PALLAS_STATE.get(key) == "ok":
                     raise  # it worked before: a real runtime failure
                 _PALLAS_STATE[key] = "broken"
+                _log.warning(
+                    "pallas two-phase kernel unavailable for shape %s "
+                    "(serving falls back to the lax.scan build, ~4x "
+                    "slower at 20M items): %s", key, e)
         return jax.device_get([
             _batch_top_n_twophase_kernel(vecs, qw, active, buckets, hp,
                                          k, chunk, bs, ksel, mb)
