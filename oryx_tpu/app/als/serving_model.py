@@ -215,8 +215,11 @@ def _phase_b(Y, Qc, active, buckets, target, M, k: int, bs: int,
     # certificate more often (never pass a true miss), preserving
     # exactness under cross-kernel accumulation-order divergence
     # (relative only: zero-padded batch rows score exactly 0 on both
-    # phases and must keep passing)
-    m_guard = m_rest + jnp.abs(m_rest) * 1e-4
+    # phases and must keep passing; -inf m_rest — every unselected
+    # block masked, e.g. a tight LSH ball — must stay -inf, not
+    # -inf + inf = NaN, which would fail every certificate)
+    m_guard = jnp.where(jnp.isfinite(m_rest),
+                        m_rest + jnp.abs(m_rest) * 1e-4, m_rest)
     cert = ts[:, k - 1] >= m_guard
     return ts, idx, cert
 

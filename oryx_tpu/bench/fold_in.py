@@ -70,16 +70,21 @@ def run_fold_in_bench(features: int = 100, events: int = 4096,
         yb = jnp.asarray(
             rng.standard_normal((bs, features)).astype(np.float32))
         ones = jnp.ones(bs, bool)
+        # fold-in kernels are sub-millisecond: the m-queue delta must
+        # be deep enough to clear the tunnel's RTT jitter or the
+        # subtraction goes negative (observed)
+        m = 64 if bs <= 4096 else 16
         t = time_exec(
             lambda: als_fold_in._fold_in_kernel(
                 chol_dev, vb, xb, ones, yb, ones, True),
-            jax.device_get, m=6)
-        exec_curve.append({
-            "batch": bs,
-            "exec_ms": t["exec_ms"],
-            "exec_events_per_s": round(bs / max(t["exec_ms"], 1e-9) * 1e3,
-                                       1),
-        })
+            jax.device_get, m=m, reps=5)
+        row = {"batch": bs, "exec_ms": t["exec_ms"]}
+        if t["exec_ms"] <= 0:
+            row["unmeasurable"] = True
+            row["exec_events_per_s"] = None
+        else:
+            row["exec_events_per_s"] = round(bs / t["exec_ms"] * 1e3, 1)
+        exec_curve.append(row)
 
     # anchor vs the reference's ACTUAL mechanism: one k x k solve per
     # event against the micro-batch's prefactored Cholesky, on a 32-core
@@ -98,8 +103,10 @@ def run_fold_in_bench(features: int = 100, events: int = 4096,
         sla.cho_solve(cf, qui.astype(np.float64))
     host_per_core_eps = n_host / (time.perf_counter() - t0)
     reference_estimate_eps = host_per_core_eps * 32
-    best_exec = max(r["exec_events_per_s"] for r in exec_curve)
-    crossover = next((r["batch"] for r in exec_curve
+    measured = [r for r in exec_curve if r["exec_events_per_s"]]
+    best_exec = max((r["exec_events_per_s"] for r in measured),
+                    default=None)
+    crossover = next((r["batch"] for r in measured
                       if r["exec_events_per_s"] > reference_estimate_eps),
                      None)
 
@@ -115,8 +122,8 @@ def run_fold_in_bench(features: int = 100, events: int = 4096,
                 round(reference_estimate_eps, 1),
             "tpu_exec_only_best_events_per_s": best_exec,
             "tpu_wins_from_batch": crossover,
-            "ratio_at_best": round(
-                best_exec / reference_estimate_eps, 2),
+            "ratio_at_best": round(best_exec / reference_estimate_eps, 2)
+            if best_exec else None,
         },
         "features": features,
         "events": events,

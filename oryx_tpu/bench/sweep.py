@@ -98,8 +98,13 @@ def run_sweep(ratings: int = 2_000_000, data_path: str | None = None,
             "lambda": float(pmml_io.get_extension_value(doc, "lambda")),
         }
 
-    best = max(evals, key=lambda d: d["eval"])
-    gate_ok = (chosen["features"] == best["features"]
+    # NaN evals are degenerate candidates the search REJECTS (reference
+    # semantics: MLUpdate skips NaN; e.g. an underregularized lambda
+    # producing singular solves) — the gate is argmax of the finite ones
+    finite = [d for d in evals if d["eval"] == d["eval"]]
+    best = max(finite, key=lambda d: d["eval"]) if finite else None
+    gate_ok = (best is not None
+               and chosen["features"] == best["features"]
                and chosen["lambda"] == best["lambda"]
                and len(evals) == n_candidates)
     return {

@@ -779,3 +779,25 @@ def test_pallas_fallback_on_unsupported_backend():
         sm._PALLAS_STATE.update(old_state)
         (sm._FLAT_SCORES_LIMIT, sm._MAX_CHUNK_ROWS,
          sm._BLOCK_KSEL, sm._PA_TILE) = old_limits
+
+
+def test_certificate_passes_when_all_unselected_blocks_masked():
+    """m_rest of -inf (every unselected block masked away, e.g. a tight
+    LSH ball) must leave the certificate passing, not poison it with
+    -inf + inf = NaN."""
+    import jax
+    import jax.numpy as jnp
+
+    from oryx_tpu.app.als import serving_model as sm
+
+    rng = np.random.default_rng(2)
+    n, f, b, k, bs, ksel = 1024, 4, 8, 8, 64, 8
+    Y = jnp.asarray(rng.standard_normal((n, f)).astype(np.float32))
+    Q = jnp.asarray(rng.standard_normal((b, f)).astype(np.float32))
+    # only the first ksel*bs rows are active: every unselected block's
+    # maximum is -inf
+    act = np.zeros(n, bool)
+    act[:ksel * bs] = True
+    ts, ti, cert = jax.device_get(sm._batch_top_n_twophase_kernel(
+        Y, Q, jnp.asarray(act), None, None, k, 256, bs, ksel, 0))
+    assert cert.all(), cert
