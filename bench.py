@@ -32,9 +32,9 @@ N_ITEMS = 1_000_000
 N_USERS = 10_000
 FEATURES = 50
 TOP_N = 10
-HTTP_WORKERS = 256
+HTTP_WORKERS = 512
 HTTP_WARMUP = 1024
-HTTP_REQUESTS = 8192
+HTTP_REQUESTS = 16384
 KERNEL_BATCH = 512
 KERNEL_BATCHES = 8
 BASELINE_QPS = 70.0  # Oryx 2, 50 features / 1M items, exact scan
