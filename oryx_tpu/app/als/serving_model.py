@@ -237,7 +237,23 @@ _PA_TILE = 4096
 # unsupported on some backends (plain CPU tests) and a compile failure
 # for one (rows, features, batch, lsh) signature must not disable the
 # kernel for other models/shapes in the same process
-_PALLAS_STATE: dict = {}  # shape key -> "ok" | "broken"
+_PALLAS_STATE: dict = {}  # shape key -> "ok" | "broken" | fail count
+# transient (non-lowering) failures tolerated on a shape before it is
+# retired to the lax.scan build for the life of the process
+_PALLAS_MAX_TRANSIENT = 3
+# a failure whose message matches none of these is treated as
+# transient (e.g. a device OOM from a concurrent dispatch) and gets
+# retried on the next drain instead of permanently killing the kernel
+_PALLAS_FATAL_MARKERS = ("mosaic", "pallas", "lowering", "unimplemented",
+                         "not implemented", "not supported", "no support",
+                         "cannot lower", "xla_tpu", "INTERNAL: Mosaic",
+                         "interpret mode", "is supported on")
+
+
+def _pallas_error_is_fatal(e: Exception) -> bool:
+    text = f"{type(e).__name__} {e}".lower()
+    return isinstance(e, NotImplementedError) or any(
+        m.lower() in text for m in _PALLAS_FATAL_MARKERS)
 
 
 @partial(jax.jit, static_argnames=("k", "bs", "ksel", "max_bits",
@@ -768,14 +784,28 @@ class ALSServingModel(FactorModelBase, ServingModel):
                     for qw in windows])
                 _PALLAS_STATE[key] = "ok"
                 return out
-            except Exception as e:  # noqa: BLE001 — lowering/compile error
+            except Exception as e:  # noqa: BLE001 — classified below
                 if _PALLAS_STATE.get(key) == "ok":
                     raise  # it worked before: a real runtime failure
-                _PALLAS_STATE[key] = "broken"
-                _log.warning(
-                    "pallas two-phase kernel unavailable for shape %s "
-                    "(serving falls back to the lax.scan build, ~4x "
-                    "slower at 20M items): %s", key, e)
+                if _pallas_error_is_fatal(e):
+                    _PALLAS_STATE[key] = "broken"
+                    _log.warning(
+                        "pallas two-phase kernel unavailable for shape "
+                        "%s (serving falls back to the lax.scan build, "
+                        "~4x slower at 20M items): %s", key, e)
+                else:
+                    # transient (device OOM, interrupted transfer, ...):
+                    # serve this drain on the scan build but leave the
+                    # kernel eligible for the next dispatch
+                    fails = _PALLAS_STATE.get(key, 0) + 1
+                    _PALLAS_STATE[key] = (
+                        "broken" if fails >= _PALLAS_MAX_TRANSIENT
+                        else fails)
+                    _log.warning(
+                        "pallas two-phase dispatch failed transiently "
+                        "for shape %s (%d/%d before retiring the "
+                        "kernel): %s", key, fails, _PALLAS_MAX_TRANSIENT,
+                        e)
         return jax.device_get([
             _batch_top_n_twophase_kernel(vecs, qw, active, buckets, hp,
                                          k, chunk, bs, ksel, mb)

@@ -128,6 +128,18 @@ def _cmd_kafka_input(args) -> int:
     return 0
 
 
+def _cmd_config_to_properties(args) -> int:
+    """Print the resolved ``oryx.*`` configuration as sorted
+    ``key=value`` .properties lines on stdout, for shell consumption —
+    the launcher-script bridge (reference: ConfigToProperties.java:29-58,
+    invoked by oryx-run.sh:87 to render config into -D properties)."""
+    props = _load_config(args.conf).to_properties()
+    for k in sorted(props):
+        if k == "oryx" or k.startswith("oryx."):
+            print(f"{k}={props[k]}")
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="oryx_tpu",
@@ -141,7 +153,9 @@ def main(argv: list[str] | None = None) -> int:
             ("serving", _cmd_serving, "run the serving (REST) layer"),
             ("kafka-setup", _cmd_kafka_setup, "create/check topics"),
             ("kafka-tail", _cmd_kafka_tail, "print topic traffic"),
-            ("kafka-input", _cmd_kafka_input, "send lines to input topic")]:
+            ("kafka-input", _cmd_kafka_input, "send lines to input topic"),
+            ("config-to-properties", _cmd_config_to_properties,
+             "print resolved oryx.* config as key=value lines")]:
         p = sub.add_parser(name, help=help_)
         p.add_argument("--conf", help="HOCON config file overlaying defaults")
         p.set_defaults(fn=fn)

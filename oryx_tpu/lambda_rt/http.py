@@ -15,6 +15,7 @@ from __future__ import annotations
 import base64
 import gzip
 import hashlib
+import html as html_mod
 import io
 import json
 import re
@@ -27,7 +28,8 @@ from typing import Any, Callable, NamedTuple
 
 from ..api.serving import HasCSV, OryxServingException
 
-__all__ = ["Route", "Request", "HttpApp", "json_or_csv", "HtmlResponse"]
+__all__ = ["Route", "Request", "HttpApp", "json_or_csv", "HtmlResponse",
+           "TextResponse", "render_error_page"]
 
 
 class HtmlResponse:
@@ -36,6 +38,47 @@ class HtmlResponse:
 
     def __init__(self, html: str):
         self.html = html
+
+
+class TextResponse:
+    """A handler result rendered verbatim as text/plain regardless of
+    Accept (the error page's text form — ErrorResource.errorText)."""
+
+    def __init__(self, text: str):
+        self.text = text
+
+
+def render_error_page(status: int, uri: str | None, message: str | None,
+                      accept: str) -> tuple[bytes, str]:
+    """The uniform error page, negotiated by Accept the way the
+    reference's error forward target renders it: an HTML document for
+    browsers, plain text otherwise (ErrorResource.java:40-120,
+    errorHTML/errorText; monospace-on-teal is its signature style).
+    Every in-flight error is rendered through here, and the /error
+    resource (serving/framework.py) is the addressable form of the same
+    page.  Returns (payload, content-type)."""
+    if "text/html" in accept:
+        parts = ["<!DOCTYPE html><html><head><title>Error</title>"
+                 '<style type="text/css">'
+                 "body{background-color:#01596e} "
+                 "body,p{font-family:monospace;color:white}"
+                 "</style></head><body>",
+                 f"<p><strong>Error {status}</strong>"]
+        if uri:
+            parts.append(f" : {html_mod.escape(uri)}")
+        parts.append("</p>")
+        if message:
+            parts.append(
+                f"<p><strong>{html_mod.escape(message)}</strong></p>")
+        parts.append("</body></html>")
+        return "".join(parts).encode(), "text/html; charset=utf-8"
+    text = f"HTTP {status}"
+    if uri:
+        text += f" : {uri}"
+    text += "\n"
+    if message:
+        text += f"{message}\n"
+    return text.encode(), "text/plain"
 
 
 class Route(NamedTuple):
@@ -85,6 +128,8 @@ def json_or_csv(value: Any, accept: str) -> tuple[bytes, str]:
     text/csv is asked for (reference: CSVMessageBodyWriter)."""
     if isinstance(value, HtmlResponse):
         return value.html.encode(), "text/html; charset=utf-8"
+    if isinstance(value, TextResponse):
+        return value.text.encode(), "text/plain"
     wants_csv = "text/csv" in accept or (
         "text/plain" in accept and "json" not in accept)
     if wants_csv:
@@ -321,11 +366,14 @@ class HttpApp:
             handler.wfile.write(payload)
 
     def _send_error(self, handler, status: int, message: str) -> None:
-        # uniform plain-text error page (reference: ErrorResource.java:36)
+        # uniform error page, HTML for browsers (reference:
+        # ErrorResource.java:36, wired as the error page for every
+        # status by ServingLayer.java:305-311)
         handler._oryx_status = status
-        payload = f"HTTP {status}\n{message}\n".encode()
+        payload, ctype = render_error_page(
+            status, None, message, handler.headers.get("Accept", ""))
         handler.send_response(status)
-        handler.send_header("Content-Type", "text/plain")
+        handler.send_header("Content-Type", ctype)
         handler.send_header("Content-Length", str(len(payload)))
         handler.end_headers()
         try:

@@ -123,11 +123,6 @@ class _Connection:
         self.max_seen_stream = 0
         self.goaway = False
         self._wlock = threading.Lock()
-        # streams refused past MAX_CONCURRENT_STREAMS: in-flight frames
-        # for them must be ignored, not treated as idle-stream errors.
-        # Insertion-ordered so overflow trims the oldest ids (ids only
-        # grow, so old entries are the ones whose DATA has drained).
-        self._refused: dict[int, None] = {}
         # queued completed requests + re-entrancy latch so a request
         # that completes while a response is blocked on flow control is
         # answered iteratively, never by nested _respond recursion
@@ -260,6 +255,7 @@ class _Connection:
                 # same invariant the HTTP/1.1 parser enforces: one
                 # client must not grow host memory without bound
                 raise H2Error(ENHANCE_YOUR_CALM, "header block too large")
+        prior_max = self.max_seen_stream
         self.max_seen_stream = max(self.max_seen_stream, sid)
         # always decode before any refusal: HPACK state is shared across
         # the connection (RFC 7541 §2.2), so a skipped block would
@@ -270,14 +266,15 @@ class _Connection:
             raise H2Error(PROTOCOL_ERROR, f"HPACK: {e}") from e
         stream = self.streams.get(sid)
         if stream is None:
-            if sid in self._refused:
-                # trailers for a stream we refused must not resurrect it
+            if sid <= prior_max:
+                # an id at or below the high-water mark with no live
+                # stream is closed — responded, reset, or refused.
+                # Trailers for it must not resurrect a stream (which
+                # would then die on a missing :method), and tracking
+                # no per-id state keeps this O(1) for any client.
                 return
             if len(self.streams) >= MAX_CONCURRENT_STREAMS:
                 # enforce the advertised SETTINGS_MAX_CONCURRENT_STREAMS
-                self._refused[sid] = None
-                while len(self._refused) > 4096:
-                    self._refused.pop(next(iter(self._refused)))
                 self.write_frame(RST_STREAM, 0, sid,
                                  struct.pack("!I", REFUSED_STREAM))
                 return
@@ -294,9 +291,9 @@ class _Connection:
     def _on_data(self, flags: int, sid: int, payload: bytes) -> None:
         stream = self.streams.get(sid)
         if stream is None:
-            if sid in self._refused:
-                # in-flight DATA for a stream we refused: drop it, but
-                # replenish the connection window it consumed
+            if sid <= self.max_seen_stream:
+                # in-flight DATA for a closed/refused stream: drop it,
+                # but replenish the connection window it consumed
                 if payload:
                     self.write_frame(WINDOW_UPDATE, 0, 0,
                                      struct.pack("!I", len(payload)))

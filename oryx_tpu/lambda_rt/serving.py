@@ -81,8 +81,10 @@ class ServingLayer:
                                                       self.input_topic)
 
         routes = self._discover_routes()
+        idle_ms = config.get_int(f"{api}.batch-idle-wait-ms")
         self.top_n_batcher = TopNBatcher(
-            pipeline=config.get_int(f"{api}.scoring-pipeline-depth"))
+            pipeline=config.get_int(f"{api}.scoring-pipeline-depth"),
+            idle_wait_s=None if idle_ms < 0 else idle_ms / 1000.0)
         self.metrics = MetricsRegistry()
         self.app = HttpApp(
             routes,

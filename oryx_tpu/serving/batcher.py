@@ -63,7 +63,8 @@ class TopNBatcher:
     device calls.  Safe across model hot-swaps: jobs carry their model,
     and each drain groups jobs by model identity."""
 
-    def __init__(self, max_batch: int = 1024, pipeline: int = 32):
+    def __init__(self, max_batch: int = 1024, pipeline: int = 32,
+                 idle_wait_s: float | None = None):
         """``pipeline`` dispatcher threads keep that many batched device
         calls in flight at once: dispatch latency (dominated by the
         host<->device round trip) overlaps instead of serializing, so
@@ -71,8 +72,17 @@ class TopNBatcher:
         Depth must cover the transport's round trip x the dispatch rate;
         32 measured best through a high-latency device tunnel and idle
         depth is just parked threads on a locally attached chip;
-        configurable via oryx.serving.api.scoring-pipeline-depth."""
+        configurable via oryx.serving.api.scoring-pipeline-depth.
+
+        ``idle_wait_s`` caps how long an idle server holds a lone
+        request hoping a burst coalesces.  None (default) adapts to the
+        measured transport: behind a high-latency tunnel the cap is
+        20 ms (noise next to the round trip), on a locally attached
+        chip (measured round trip under ~5 ms) it is 0 — immediate
+        dispatch.  Configurable via
+        oryx.serving.api.batch-idle-wait-ms (-1 = adaptive)."""
         self.max_batch = max_batch
+        self._idle_wait = idle_wait_s
         self._cond = threading.Condition()
         self._pending: list[_Job] = []
         self._stopped = False
@@ -180,8 +190,14 @@ class TopNBatcher:
                     if self._in_flight == 0:
                         # device idle: wait only a small fraction of a
                         # service time, so a burst coalesces but a lone
-                        # request on a cheap model goes ~immediately
-                        wait = min(0.02, self._exec_ewma / 8) - age
+                        # request on a cheap model goes ~immediately;
+                        # with a locally attached chip (tiny measured
+                        # round trip) don't hold it at all
+                        cap = self._idle_wait
+                        if cap is None:
+                            rtt = self._wall_min - self._exec_ewma
+                            cap = 0.02 if rtt > 0.005 else 0.0
+                        wait = min(cap, self._exec_ewma / 8) - age
                     elif self._in_flight < self._in_flight_target():
                         # device busy: coalesce one service interval
                         wait = self._exec_ewma - age

@@ -1,8 +1,10 @@
-"""Framework-level serving resources: readiness + shared helpers.
+"""Framework-level serving resources: readiness, the error page, and
+shared helpers.
 
 Reference: app/oryx-app-serving/.../Ready.java:34 (HEAD/GET /ready ->
 200/503 against min-model-load-fraction),
-AbstractOryxResource.java:52-... (model gating, input send).
+AbstractOryxResource.java:52-... (model gating, input send),
+ErrorResource.java:36 (the error-page forward target).
 """
 
 from __future__ import annotations
@@ -11,7 +13,8 @@ import zlib
 from typing import Any
 
 from ..api.serving import OryxServingException
-from ..lambda_rt.http import Request, Route
+from ..lambda_rt.http import (HtmlResponse, Request, Route, TextResponse,
+                              render_error_page)
 
 __all__ = ["ROUTES", "get_serving_model", "send_input"]
 
@@ -47,6 +50,24 @@ def _ready(req: Request):
     raise OryxServingException(503, "Model not available yet")
 
 
+def _error(req: Request):
+    """Explicit error-page resource: renders error info carried in the
+    query string, where the reference's container forwards errored
+    requests with RequestDispatcher.ERROR_* attributes
+    (ErrorResource.java:36; wired as the error page for every status in
+    ServingLayer.java:305-311).  The hand-rolled server renders
+    in-flight errors directly through render_error_page, so this
+    endpoint is the addressable form of the same page."""
+    code = req.q1("code", "")
+    status = int(code) if code and code.isdigit() else 200
+    payload, ctype = render_error_page(
+        status, req.q1("uri"), req.q1("message"),
+        req.headers.get("Accept", ""))
+    if ctype.startswith("text/html"):
+        return status, HtmlResponse(payload.decode())
+    return status, TextResponse(payload.decode())
+
+
 def _metrics(req: Request):
     """Per-route request counts, error counts, and latency percentiles
     (the reference exposes only logs + Spark UI — SURVEY §5.1/5.5; this
@@ -76,5 +97,6 @@ def _metrics(req: Request):
 
 ROUTES = [
     Route("GET", "/ready", _ready),
+    Route("GET", "/error", _error),
     Route("GET", "/metrics", _metrics),
 ]

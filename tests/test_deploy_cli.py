@@ -103,3 +103,23 @@ def test_file_broker_live_between_processes(tmp_path):
     # and offsets committed by this process merge with the file
     broker.set_offset("g2", "CliIn", 2)
     broker.flush()
+
+
+def test_cli_config_to_properties(tmp_path, capsys):
+    """config-to-properties prints the resolved oryx.* tree as sorted
+    key=value lines for shell consumption (reference:
+    ConfigToProperties.java:29-58, used by oryx-run.sh:87)."""
+    from oryx_tpu.deploy.main import main
+
+    conf = tmp_path / "t.conf"
+    conf.write_text('oryx.id = "props-test"\n')
+    assert main(["config-to-properties", "--conf", str(conf)]) == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert out == sorted(out)
+    assert all("=" in line and line.startswith("oryx") for line in out)
+    kv = dict(line.split("=", 1) for line in out)
+    assert kv["oryx.id"] == "props-test"
+    # nulls are omitted like the reference's NULL case
+    assert "oryx.serving.api.user-name" not in kv
+    # defaults from reference.conf are resolved in
+    assert kv["oryx.serving.api.read-only"] == "false"

@@ -538,3 +538,53 @@ def test_streams_past_advertised_cap_are_refused(h2_server):
             if ftype == 1 and sid == 1:
                 status = payload[0]
         assert status == 0x89  # :status 204, HPACK static index 9
+
+
+def test_late_frames_on_closed_streams_do_not_kill_connection(h2_server):
+    """DATA or trailer HEADERS racing a completed/refused stream must be
+    dropped as frames on a *closed* stream (any unknown id at or below
+    the connection's high-water mark), not treated as idle-stream
+    protocol errors that tear down every healthy stream on the
+    connection (RFC 9113 §5.1 closed-state tolerance)."""
+    from oryx_tpu.lambda_rt import http2 as h2mod
+
+    enc = HpackEncoder()
+
+    def headers_frame(sid, end_stream=True):
+        block = enc.encode([(":method", "GET"), (":path", "/ready"),
+                            (":scheme", "http"), (":authority", "a")])
+        flags = 0x4 | (0x1 if end_stream else 0)
+        return (len(block).to_bytes(3, "big") + bytes([1, flags])
+                + sid.to_bytes(4, "big") + block)
+
+    def read_response(r, want_sid):
+        while True:
+            head = r.read(9)
+            assert head, "connection closed unexpectedly"
+            length = int.from_bytes(head[:3], "big")
+            ftype, flags = head[3], head[4]
+            sid = int.from_bytes(head[5:9], "big") & 0x7FFFFFFF
+            payload = r.read(length)
+            if ftype == 7:  # GOAWAY
+                raise AssertionError(f"GOAWAY: {payload!r}")
+            if ftype == 1 and sid == want_sid:
+                return payload[0]
+
+    with socket.create_connection(("127.0.0.1", h2_server),
+                                  timeout=10) as s:
+        s.sendall(h2mod.PREFACE)
+        s.sendall(b"\x00\x00\x00\x04\x00\x00\x00\x00\x00")  # SETTINGS
+        r = s.makefile("rb")
+        # complete stream 1, then throw late frames at its closed id
+        s.sendall(headers_frame(1))
+        assert read_response(r, 1) == 0x89  # :status 204
+        # late DATA for the closed stream (5 bytes, END_STREAM)
+        s.sendall(b"\x00\x00\x05\x00\x01" + (1).to_bytes(4, "big")
+                  + b"hello")
+        # late trailers for the closed stream must not resurrect it
+        trailer_block = enc.encode([("x-late", "1")])
+        s.sendall(len(trailer_block).to_bytes(3, "big") + bytes([1, 0x5])
+                  + (1).to_bytes(4, "big") + trailer_block)
+        # the connection is still healthy: stream 3 completes normally
+        s.sendall(headers_frame(3))
+        assert read_response(r, 3) == 0x89

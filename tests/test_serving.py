@@ -610,3 +610,48 @@ def test_obs_fold_continuation_rejected(server):
         s.sendall(b"GET /ready HTTP/1.1\r\nHost: a\r\n"
                   b"X-A: one\r\n two\r\n\r\n")
         assert b"400" in s.makefile("rb").readline()
+
+
+def test_error_resource(server):
+    """The /error resource is the addressable form of the uniform error
+    page (reference: ErrorResource.java:36): it renders status/uri/
+    message from the query string, HTML for browsers and plain text
+    otherwise, and returns the carried status code."""
+    # plain text form, carrying a status
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}/error?code=404&uri=/nope"
+        "&message=gone", headers={"Accept": "text/plain"})
+    try:
+        urllib.request.urlopen(req, timeout=10)
+        raise AssertionError("expected HTTP 404")
+    except urllib.error.HTTPError as e:
+        body = e.read().decode()
+        assert e.code == 404
+        assert "HTTP 404" in body and "/nope" in body and "gone" in body
+    # HTML form for browsers
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}/error?message=<boom>",
+        headers={"Accept": "text/html"})
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        body = resp.read().decode()
+        assert resp.headers["Content-Type"].startswith("text/html")
+        assert "&lt;boom&gt;" in body  # script-safe escaping
+        assert "Error" in body
+
+
+def test_inline_errors_negotiate_html(server):
+    """An in-flight error (404 route miss) renders the same page: plain
+    text by default, the HTML document when the client is a browser
+    (reference: ServingLayer.java:305-311 forwards every error status
+    to ErrorResource)."""
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}/no-such-endpoint",
+        headers={"Accept": "text/html"})
+    try:
+        urllib.request.urlopen(req, timeout=10)
+        raise AssertionError("expected HTTP 404")
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+        body = e.read().decode()
+        assert e.headers["Content-Type"].startswith("text/html")
+        assert "<strong>Error 404</strong>" in body
