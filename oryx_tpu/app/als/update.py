@@ -12,20 +12,19 @@ splitNewDataToTrainTest :326-343.
 
 from __future__ import annotations
 
-import glob
 import gzip
+import io
 import json
 import logging
-import os
 from typing import Sequence
 from xml.etree.ElementTree import Element
 
 import numpy as np
 
 from ...common import pmml as pmml_io
+from ...common import store
 from ...common import text as text_utils
 from ...common.config import Config
-from ...common.io_utils import mkdirs, strip_scheme
 from ...kafka.api import KEY_UP, KeyMessage, TopicProducer
 from ...ml import params as hp
 from ...ml.mlupdate import MLUpdate
@@ -40,11 +39,12 @@ __all__ = ["ALSUpdate", "save_features", "load_features"]
 
 def save_features(path: str, ids: Sequence[str], matrix: np.ndarray) -> None:
     """Write a factor matrix as gzipped JSON lines ``["id",[floats]]`` —
-    the artifact format serving/speed layers read back
-    (reference: ALSUpdate.saveFeaturesRDD :490-499)."""
-    path = mkdirs(strip_scheme(path))
-    with gzip.open(os.path.join(path, "part-00000.gz"), "wt",
-                   encoding="utf-8") as f:
+    the artifact format serving/speed layers read back, on any store
+    scheme (reference: ALSUpdate.saveFeaturesRDD :490-499 writes to the
+    shared filesystem)."""
+    path = store.mkdirs(path)
+    with store.open_write(store.join(path, "part-00000.gz")) as raw, \
+            gzip.open(raw, "wt", encoding="utf-8") as f:
         for id_, row in zip(ids, matrix):
             f.write(text_utils.join_json([id_, [round(float(v), 8) for v in row]]))
             f.write("\n")
@@ -55,16 +55,17 @@ def load_features(path: str) -> tuple[list[str], np.ndarray]:
     (reference: ALSUpdate.readFeaturesRDD :533-541)."""
     ids: list[str] = []
     rows: list[list[float]] = []
-    path = strip_scheme(path)
-    parts = sorted(glob.glob(os.path.join(path, "part-*")))
-    for part in parts:
-        opener = gzip.open if part.endswith(".gz") else open
-        with opener(part, "rt", encoding="utf-8") as f:
-            for line in f:
-                if line.strip():
-                    id_, vector = json.loads(line)
-                    ids.append(str(id_))
-                    rows.append(vector)
+    for part in store.glob(path, "part-*"):
+        with store.open_read(part) as raw:
+            opener = gzip.open(raw, "rt", encoding="utf-8") \
+                if part.endswith(".gz") \
+                else io.TextIOWrapper(raw, encoding="utf-8")
+            with opener as f:
+                for line in f:
+                    if line.strip():
+                        id_, vector = json.loads(line)
+                        ids.append(str(id_))
+                        rows.append(vector)
     matrix = np.asarray(rows, dtype=np.float32) if rows else \
         np.zeros((0, 0), dtype=np.float32)
     return ids, matrix
@@ -131,8 +132,8 @@ class ALSUpdate(MLUpdate):
         """Ad-hoc factored-matrix serialization: the PMML carries pointers
         to the X/ Y/ artifact dirs plus the ID lists
         (reference: mfModelToPMML :430-473)."""
-        save_features(os.path.join(candidate_path, "X"), model.user_ids, model.X)
-        save_features(os.path.join(candidate_path, "Y"), model.item_ids, model.Y)
+        save_features(store.join(candidate_path, "X"), model.user_ids, model.X)
+        save_features(store.join(candidate_path, "Y"), model.item_ids, model.Y)
         doc = pmml_io.build_skeleton_pmml()
         pmml_io.add_extension(doc, "X", "X/")
         pmml_io.add_extension(doc, "Y", "Y/")
@@ -152,8 +153,8 @@ class ALSUpdate(MLUpdate):
 
     def evaluate(self, model: Element, candidate_path: str,
                  test_data, train_data) -> float:
-        x_ids, X = load_features(os.path.join(candidate_path, "X"))
-        y_ids, Y = load_features(os.path.join(candidate_path, "Y"))
+        x_ids, X = load_features(store.join(candidate_path, "X"))
+        y_ids, Y = load_features(store.join(candidate_path, "Y"))
         uidx = {u: j for j, u in enumerate(x_ids)}
         iidx = {i: j for j, i in enumerate(y_ids)}
 
@@ -198,13 +199,13 @@ class ALSUpdate(MLUpdate):
         user endpoints return complete results once they stop 404ing
         (reference: publishAdditionalModelData :287-319)."""
         y_rel = pmml_io.get_extension_value(model, "Y")
-        y_ids, Y = load_features(os.path.join(model_path, y_rel))
+        y_ids, Y = load_features(store.join(model_path, y_rel))
         for id_, row in zip(y_ids, Y):
             model_update_topic.send(KEY_UP, text_utils.join_json(
                 ["Y", id_, [float(v) for v in row]]))
 
         x_rel = pmml_io.get_extension_value(model, "X")
-        x_ids, X = load_features(os.path.join(model_path, x_rel))
+        x_ids, X = load_features(store.join(model_path, x_rel))
         if self.no_known_items:
             for id_, row in zip(x_ids, X):
                 model_update_topic.send(KEY_UP, text_utils.join_json(

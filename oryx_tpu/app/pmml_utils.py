@@ -9,13 +9,11 @@ buildMiningSchema :131, buildDataDictionary :198, toArray :116.
 from __future__ import annotations
 
 import logging
-import os
 import xml.etree.ElementTree as ET
 from xml.etree.ElementTree import Element
 
 from ..common import pmml as pmml_io
 from ..common import text as text_utils
-from ..common.io_utils import strip_scheme
 from ..kafka.api import KEY_MODEL, KEY_MODEL_REF
 from .schema import CategoricalValueEncodings, InputSchema
 
@@ -119,12 +117,19 @@ def to_pmml_array(values) -> Element:
 
 
 def read_pmml_from_update_key_message(key: str, message: str) -> Element | None:
+    """MODEL -> parse inline XML; MODEL-REF -> resolve the path through
+    the scheme-routed store, so a serving process reads a model the
+    trainer published on a shared filesystem/object store (reference:
+    AppPMMLUtils.readPMMLFromUpdateKeyMessage :259 opens the HDFS
+    path)."""
     if key == KEY_MODEL:
         return pmml_io.from_string(message)
     if key == KEY_MODEL_REF:
-        path = strip_scheme(message)
-        if not os.path.exists(path):
-            _log.warning("Unable to load model file at %s; ignoring", path)
+        # open-and-catch, not exists-then-read: TTL cleanup may race
+        # the resolve, and one round trip beats two on a remote store
+        try:
+            return pmml_io.read(message)
+        except (FileNotFoundError, OSError):
+            _log.warning("Unable to load model file at %s; ignoring", message)
             return None
-        return pmml_io.read(path)
     raise ValueError(f"Bad key: {key}")

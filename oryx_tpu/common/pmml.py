@@ -15,12 +15,10 @@ MiningModel for forests, ClusteringModel for k-means.
 from __future__ import annotations
 
 import datetime
-import os
 import xml.etree.ElementTree as ET
 from typing import Any, Sequence
 
 from . import text as text_utils
-from .io_utils import mkdirs, strip_scheme
 
 __all__ = [
     "PMML_NS", "build_skeleton_pmml", "to_string", "from_string",
@@ -59,13 +57,18 @@ def from_string(s: str) -> ET.Element:
 
 
 def read(path: str) -> ET.Element:
-    return ET.parse(strip_scheme(path)).getroot()
+    """Parse a PMML document from any store scheme (reference:
+    PMMLUtils.read; MODEL-REF paths may point at a shared store)."""
+    from . import store
+    with store.open_read(path) as f:
+        return ET.parse(f).getroot()
 
 
 def write(root: ET.Element, path: str) -> None:
-    path = strip_scheme(path)
-    mkdirs(os.path.dirname(path))
-    ET.ElementTree(root).write(path, encoding="utf-8", xml_declaration=True)
+    from . import store
+    with store.open_write(path) as f:
+        ET.ElementTree(root).write(f, encoding="utf-8",
+                                   xml_declaration=True)
 
 
 # -- Extension helpers (AppPMMLUtils parity) --------------------------------

@@ -1,0 +1,149 @@
+"""Scheme-routed artifact store: the shared filesystem behind
+``data-dir``, ``model-dir`` and the ``MODEL-REF`` convention.
+
+Reference: the batch layer reads and writes a *shared* filesystem so
+trainer and serving can live on different hosts — generations as HDFS
+SequenceFiles (SaveToHDFSFunction.java:35-86,
+BatchUpdateFunction.java:103-130), models overflowed by reference
+(MLUpdate.java:233-237) and resolved from any layer
+(AppPMMLUtils.readPMMLFromUpdateKeyMessage :259).  The TPU build routes
+the same roles by URI scheme instead of hardwiring Hadoop:
+
+- ``file://`` (or a bare path): POSIX fast path — ``os``/``glob``
+  directly, atomic publish via ``os.replace``.
+- any other scheme (``gs://``, ``s3://``, ``memory://`` ...): fsspec,
+  loaded lazily so the dependency only matters when a remote scheme is
+  configured.  ``memory://`` is fsspec's built-in in-process filesystem
+  and serves as the remote-store fake in tests; ``gs://``/``s3://``
+  work wherever their fsspec drivers are installed.
+
+All functions take full URIs, so a ``MODEL-REF`` message can carry its
+scheme end-to-end and a serving process resolves it with no knowledge
+of how the trainer was configured.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import IO
+
+from . import io_utils
+from .io_utils import strip_scheme
+
+__all__ = [
+    "is_local", "open_read", "open_write", "exists", "getsize",
+    "glob", "mkdirs", "delete_recursively", "rename", "join",
+]
+
+
+def _scheme(uri: str) -> str | None:
+    """The non-file scheme of a URI, or None for local paths.  A lone
+    drive-letter-style or schemeless path is local; ``file:`` in any
+    spelling is local."""
+    i = uri.find("://")
+    if i <= 0:
+        return None  # bare path or file:/x spelling — local either way
+    scheme = uri[:i]
+    return None if scheme == "file" else scheme
+
+
+def is_local(uri: str) -> bool:
+    return _scheme(uri) is None
+
+
+def _fs(uri: str):
+    """(fsspec filesystem, bare path) for a remote URI."""
+    import fsspec
+    return fsspec.core.url_to_fs(uri)
+
+
+def _requote(uri: str, bare_path: str) -> str:
+    """Re-attach the URI's scheme to a bare fs path so listings keep
+    their full addressable form."""
+    return f"{_scheme(uri)}://{bare_path.lstrip('/')}" \
+        if _scheme(uri) else bare_path
+
+
+def join(base: str, *parts: str) -> str:
+    """URI-preserving path join (all schemes use / separators)."""
+    out = base.rstrip("/")
+    for p in parts:
+        out += "/" + str(p).strip("/")
+    return out
+
+
+def open_read(uri: str, mode: str = "rb") -> IO:
+    if is_local(uri):
+        return open(strip_scheme(uri), mode)
+    import fsspec
+    return fsspec.open(uri, mode).open()
+
+
+def open_write(uri: str, mode: str = "wb") -> IO:
+    if is_local(uri):
+        path = strip_scheme(uri)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        return open(path, mode)
+    import fsspec
+    return fsspec.open(uri, mode).open()
+
+
+def exists(uri: str) -> bool:
+    if is_local(uri):
+        return os.path.exists(strip_scheme(uri))
+    fs, path = _fs(uri)
+    return fs.exists(path)
+
+
+def getsize(uri: str) -> int:
+    if is_local(uri):
+        return os.path.getsize(strip_scheme(uri))
+    fs, path = _fs(uri)
+    return fs.size(path)
+
+
+def glob(dir_uri: str, pattern: str = "*") -> list[str]:
+    """Sorted entries under a directory matching a glob pattern, in the
+    directory's own URI form (reference: IOUtils.listFiles +
+    BatchUpdateFunction's data-dir glob)."""
+    if is_local(dir_uri):
+        return io_utils.list_files(dir_uri, pattern)
+    fs, path = _fs(dir_uri)
+    return sorted(_requote(dir_uri, p)
+                  for p in fs.glob(path.rstrip("/") + "/" + pattern))
+
+
+def mkdirs(uri: str) -> str:
+    """Ensure the directory exists; returns the URI (local: the bare
+    path, preserving the historical io_utils.mkdirs contract)."""
+    if is_local(uri):
+        return io_utils.mkdirs(uri)
+    fs, path = _fs(uri)
+    fs.makedirs(path, exist_ok=True)
+    return uri
+
+
+def delete_recursively(uri: str) -> None:
+    if is_local(uri):
+        io_utils.delete_recursively(uri)
+        return
+    fs, path = _fs(uri)
+    if fs.exists(path):
+        with contextlib.suppress(FileNotFoundError):
+            fs.rm(path, recursive=True)
+
+
+def rename(src_uri: str, dst_uri: str) -> None:
+    """Publish-by-rename (reference: MLUpdate.java:205-211 renames the
+    winning candidate into model-dir).  Atomic on POSIX; on object
+    stores fsspec's mv is copy+delete, which keeps the same
+    eventual-visibility contract the reference relies on HDFS rename
+    for (readers only learn the path from the update topic *after* the
+    move completes)."""
+    if is_local(src_uri) and is_local(dst_uri):
+        os.replace(strip_scheme(src_uri), strip_scheme(dst_uri))
+        return
+    fs, src = _fs(src_uri)
+    _, dst = _fs(dst_uri)
+    fs.mv(src, dst, recursive=True)

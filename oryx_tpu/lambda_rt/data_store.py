@@ -1,28 +1,30 @@
-"""Generation-file data store: historical input on disk + TTL cleanup.
+"""Generation-file data store: historical input + TTL cleanup, on any
+store scheme.
 
 Reference: the batch layer persists each generation's input as
-timestamped SequenceFiles under data-dir and re-reads ALL of them as
-"past data" each generation (SaveToHDFSFunction.java:35-86 writes
-``oryx-<timestampMs>.data`` idempotently; BatchUpdateFunction.java:103-130
-globs ``data-dir/*/part-*``), and TTL-deletes old data/model dirs
+timestamped SequenceFiles under data-dir on a *shared* filesystem and
+re-reads ALL of them as "past data" each generation
+(SaveToHDFSFunction.java:35-86 writes ``oryx-<timestampMs>.data``
+idempotently; BatchUpdateFunction.java:103-130 globs
+``data-dir/*/part-*``), and TTL-deletes old data/model dirs
 (DeleteOldDataFn.java:37-79).
 
 Here a generation is one gzipped JSONL file of [key, message] pairs —
-same role, POSIX/object-store friendly.
+same role, routed through common.store so data-dir may live on POSIX,
+``memory://`` (tests) or an object store (``gs://``/``s3://``).
 """
 
 from __future__ import annotations
 
-import glob
 import gzip
 import json
 import logging
 import os
 import re
 import time
-from typing import Iterable, Sequence
+from typing import Sequence
 
-from ..common.io_utils import delete_recursively, mkdirs, strip_scheme
+from ..common import store
 from ..kafka.api import KeyMessage
 
 _log = logging.getLogger(__name__)
@@ -35,17 +37,18 @@ _DATA_FILE_RE = re.compile(r"^oryx-(\d+)\.data\.jsonl\.gz$")
 
 def save_generation(data_dir: str, timestamp_ms: int,
                     data: Sequence[KeyMessage]) -> str | None:
-    """Write one generation's input; idempotent (overwrites a partial
-    earlier attempt, as the reference deletes partial output)."""
+    """Write one generation's input; idempotent (a partial earlier
+    attempt is replaced, as the reference deletes partial output)."""
     if not data:
         return None
-    data_dir = mkdirs(data_dir)
-    path = os.path.join(data_dir, f"oryx-{timestamp_ms}.data.jsonl.gz")
+    store.mkdirs(data_dir)
+    path = store.join(data_dir, f"oryx-{timestamp_ms}.data.jsonl.gz")
     tmp = path + ".tmp"
-    with gzip.open(tmp, "wt", encoding="utf-8") as f:
+    with store.open_write(tmp) as raw, \
+            gzip.open(raw, "wt", encoding="utf-8") as f:
         for km in data:
             f.write(json.dumps([km.key, km.message]) + "\n")
-    os.replace(tmp, path)
+    store.rename(tmp, path)
     return path
 
 
@@ -53,15 +56,15 @@ def read_all_data(data_dir: str,
                   before_timestamp_ms: int | None = None) -> list[KeyMessage]:
     """All stored generations (optionally only those strictly older than
     a timestamp), in generation order."""
-    data_dir = strip_scheme(data_dir)
     out: list[KeyMessage] = []
-    for path in sorted(glob.glob(os.path.join(data_dir, "oryx-*.data.jsonl.gz"))):
+    for path in store.glob(data_dir, "oryx-*.data.jsonl.gz"):
         m = _DATA_FILE_RE.match(os.path.basename(path))
         if not m:
             continue
         if before_timestamp_ms is not None and int(m.group(1)) >= before_timestamp_ms:
             continue
-        with gzip.open(path, "rt", encoding="utf-8") as f:
+        with store.open_read(path) as raw, \
+                gzip.open(raw, "rt", encoding="utf-8") as f:
             for line in f:
                 if line.strip():
                     k, msg = json.loads(line)
@@ -75,11 +78,11 @@ def _delete_older_than(dir_path: str, pattern: str, extract_ts, max_age_hours: i
         return 0
     cutoff = int(time.time() * 1000) - max_age_hours * 3_600_000
     deleted = 0
-    for path in glob.glob(os.path.join(strip_scheme(dir_path), pattern)):
+    for path in store.glob(dir_path, pattern):
         ts = extract_ts(os.path.basename(path))
         if ts is not None and ts < cutoff:
             _log.info("Deleting old %s %s", kind, path)
-            delete_recursively(path)
+            store.delete_recursively(path)
             deleted += 1
     return deleted
 

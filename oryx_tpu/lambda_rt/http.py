@@ -376,6 +376,8 @@ class HttpApp:
         handler.send_header("Content-Type", ctype)
         handler.send_header("Content-Length", str(len(payload)))
         handler.end_headers()
+        if getattr(handler, "command", None) == "HEAD":
+            return  # HEAD: headers only, or keep-alive framing breaks
         try:
             handler.wfile.write(payload)
         except BrokenPipeError:

@@ -19,8 +19,9 @@ from typing import Sequence
 from xml.etree.ElementTree import Element
 
 from ..common import pmml as pmml_io
+from ..common import store
 from ..common.config import Config
-from ..common.io_utils import delete_recursively, mkdirs
+from ..common.io_utils import mkdirs
 from ..common.lang import collect_in_parallel
 from ..common.rand import RandomManager
 from ..kafka.api import KEY_MODEL, KEY_MODEL_REF, KeyMessage, TopicProducer
@@ -112,10 +113,10 @@ class MLUpdate(BatchLayerUpdate, abc.ABC):
         per_param = hp.choose_values_per_hyperparam(len(ranges), self.candidates)
         combos = hp.choose_hyper_parameter_combos(ranges, self.candidates, per_param)
 
-        model_dir_local = mkdirs(model_dir)
-        candidates_path = os.path.join(model_dir_local, ".temporary",
-                                       str(int(time.time() * 1000)))
-        mkdirs(candidates_path)
+        model_dir = store.mkdirs(model_dir)
+        candidates_path = store.join(model_dir, ".temporary",
+                                     str(int(time.time() * 1000)))
+        store.mkdirs(candidates_path)
 
         if self.profile_dir:
             import jax
@@ -127,19 +128,19 @@ class MLUpdate(BatchLayerUpdate, abc.ABC):
             best_candidate = self._find_best_candidate_path(
                 new_data, past_data, combos, candidates_path)
 
-        final_path = os.path.join(model_dir_local, str(int(time.time() * 1000)))
+        final_path = store.join(model_dir, str(int(time.time() * 1000)))
         if best_candidate is None:
             _log.info("Unable to build any model")
         else:
-            os.replace(best_candidate, final_path)  # atomic publish
-        delete_recursively(os.path.join(model_dir_local, ".temporary"))
+            store.rename(best_candidate, final_path)  # atomic publish
+        store.delete_recursively(store.join(model_dir, ".temporary"))
 
         if model_update_topic is None:
             _log.info("No update topic configured, not publishing models")
         else:
-            best_model_path = os.path.join(final_path, MODEL_FILE_NAME)
-            if os.path.exists(best_model_path):
-                size = os.path.getsize(best_model_path)
+            best_model_path = store.join(final_path, MODEL_FILE_NAME)
+            if store.exists(best_model_path):
+                size = store.getsize(best_model_path)
                 needed = self.can_publish_additional_model_data()
                 not_too_large = size <= self.max_message_size
                 best_model = None
@@ -164,7 +165,7 @@ class MLUpdate(BatchLayerUpdate, abc.ABC):
 
         best_path, best_eval = None, float("-inf")
         for path, eval_ in results:
-            if path is None or not os.path.exists(path):
+            if path is None or not store.exists(path):
                 continue
             if eval_ == eval_:  # not NaN
                 if eval_ > best_eval:
@@ -182,7 +183,7 @@ class MLUpdate(BatchLayerUpdate, abc.ABC):
     def _build_and_eval(self, i: int, combos, new_data, past_data,
                         candidates_path: str) -> tuple[str | None, float]:
         hyper_parameters = combos[i % len(combos)]
-        candidate_path = os.path.join(candidates_path, str(i))
+        candidate_path = store.join(candidates_path, str(i))
         _log.info("Building candidate %d with params %s", i, hyper_parameters)
 
         train, test = self._split_train_test(new_data, past_data)
@@ -194,8 +195,8 @@ class MLUpdate(BatchLayerUpdate, abc.ABC):
         if model is None:
             _log.info("Unable to build a model")
             return candidate_path, eval_
-        mkdirs(candidate_path)
-        model_path = os.path.join(candidate_path, MODEL_FILE_NAME)
+        store.mkdirs(candidate_path)
+        model_path = store.join(candidate_path, MODEL_FILE_NAME)
         pmml_io.write(model, model_path)
         if not test:
             _log.info("No test data available to evaluate model")

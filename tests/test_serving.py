@@ -655,3 +655,23 @@ def test_inline_errors_negotiate_html(server):
         body = e.read().decode()
         assert e.headers["Content-Type"].startswith("text/html")
         assert "<strong>Error 404</strong>" in body
+
+
+def test_head_error_keeps_keepalive_framing(server):
+    """A HEAD request that errors must send headers only: writing the
+    error body would desynchronize keep-alive framing for the next
+    response on the connection (RFC 9110 §9.3.2)."""
+    import http.client
+    conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=10)
+    try:
+        conn.request("HEAD", "/no-such-endpoint")
+        resp = conn.getresponse()
+        assert resp.status == 404
+        assert resp.read() == b""  # http.client enforces no-body for HEAD
+        # the connection is still usable and correctly framed
+        conn.request("GET", "/ready")
+        resp2 = conn.getresponse()
+        assert resp2.status in (200, 204)
+        resp2.read()
+    finally:
+        conn.close()

@@ -1,0 +1,159 @@
+"""Scheme-routed storage plane (common/store.py): the shared-filesystem
+role HDFS plays in the reference (SaveToHDFSFunction.java:35-86,
+MLUpdate.java:233-237, AppPMMLUtils.readPMMLFromUpdateKeyMessage :259).
+
+``memory://`` (fsspec's in-process filesystem) stands in for a remote
+object store; ``file://`` is exercised across *processes with different
+cwds* to prove a MODEL-REF published by a trainer resolves from a
+separately-launched serving process.
+"""
+
+import gzip
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+pytest.importorskip("fsspec")
+
+from oryx_tpu.common import store
+from oryx_tpu.common import pmml as pmml_io
+from oryx_tpu.kafka.api import KEY_MODEL_REF, KeyMessage
+from oryx_tpu.lambda_rt import data_store
+
+
+def _clear_memory_fs():
+    import fsspec
+    fs = fsspec.filesystem("memory")
+    for p in list(fs.store):
+        fs.store.pop(p, None)
+    fs.pseudo_dirs[:] = [""]
+
+
+@pytest.fixture(autouse=True)
+def memory_fs():
+    _clear_memory_fs()
+    yield
+    _clear_memory_fs()
+
+
+def test_store_primitives_memory_scheme():
+    base = "memory://bucket/dir"
+    p = store.join(base, "sub", "file.txt")
+    assert p == "memory://bucket/dir/sub/file.txt"
+    assert not store.exists(p)
+    with store.open_write(p) as f:
+        f.write(b"hello")
+    assert store.exists(p) and store.getsize(p) == 5
+    with store.open_read(p) as f:
+        assert f.read() == b"hello"
+    assert store.glob(store.join(base, "sub"), "*.txt") == [p]
+    store.rename(p, store.join(base, "sub", "renamed.txt"))
+    assert not store.exists(p)
+    assert store.exists(store.join(base, "sub", "renamed.txt"))
+    store.delete_recursively(store.join(base, "sub"))
+    assert not store.exists(store.join(base, "sub", "renamed.txt"))
+
+
+def test_store_primitives_local(tmp_path):
+    base = f"file://{tmp_path}"
+    p = store.join(base, "a", "b.bin")
+    with store.open_write(p) as f:
+        f.write(b"x" * 10)
+    assert (tmp_path / "a" / "b.bin").read_bytes() == b"x" * 10
+    assert store.getsize(p) == 10
+    assert store.is_local(p) and not store.is_local("memory://x/y")
+
+
+def test_generations_on_memory_store():
+    data_dir = "memory://lake/data"
+    data = [KeyMessage("k", "1,2,3"), KeyMessage(None, "4,5,6")]
+    path = data_store.save_generation(data_dir, 1000, data)
+    assert path.startswith("memory://")
+    data_store.save_generation(data_dir, 2000, [KeyMessage("z", "7,8,9")])
+    got = data_store.read_all_data(data_dir)
+    assert [km.message for km in got] == ["1,2,3", "4,5,6", "7,8,9"]
+    # TTL deletion routes through the same store
+    assert data_store.delete_old_data(data_dir, 0) == 2
+    assert data_store.read_all_data(data_dir) == []
+
+
+def test_mlupdate_publishes_model_ref_through_memory_store():
+    """The full batch loop on a remote-scheme model-dir: candidates are
+    built, the winner is rename-published, and (with a tiny
+    max-message-size, the reference's tier-3 trick —
+    AbstractLambdaIT.java:104) the model goes out as a MODEL-REF whose
+    URI resolves through the store from a consumer that shares no cwd
+    with the trainer."""
+    from oryx_tpu.app.als.update import ALSUpdate
+    from oryx_tpu.app.pmml_utils import read_pmml_from_update_key_message
+    from oryx_tpu.common.config import from_dict
+
+    cfg = from_dict({
+        "oryx.update-topic.message.max-size": 1 << 7,  # force MODEL-REF
+        "oryx.ml.eval.test-fraction": 0.0,
+        "oryx.ml.eval.candidates": 1,
+        "oryx.als.hyperparams.features": 4,
+        "oryx.als.hyperparams.lambda": 0.001,
+        "oryx.als.implicit": True,
+    })
+    rng = np.random.default_rng(7)
+    data = [KeyMessage(None, f"u{rng.integers(20)},i{rng.integers(30)},1")
+            for _ in range(300)]
+
+    sent = []
+
+    class Capture:
+        def send(self, key, message):
+            sent.append((key, message))
+
+    ALSUpdate(cfg).run_update(0, data, [], "memory://lake/model", Capture())
+    keys = [k for k, _ in sent]
+    assert KEY_MODEL_REF in keys, keys
+    ref = dict(sent)[KEY_MODEL_REF]
+    assert ref.startswith("memory://lake/model/")
+    # the .temporary staging dir is cleaned after the atomic publish
+    assert store.glob("memory://lake/model", ".temporary/*") == []
+    # a consumer resolves the REF through the store alone
+    doc = read_pmml_from_update_key_message(KEY_MODEL_REF, ref)
+    assert doc is not None
+    assert pmml_io.get_extension_value(doc, "features") == "4"
+    # and the X/Y artifacts load from the same store
+    from oryx_tpu.app.als.update import load_features
+    model_dir = ref.rsplit("/", 1)[0]
+    y_ids, Y = load_features(store.join(model_dir, "Y"))
+    assert len(y_ids) == Y.shape[0] > 0 and Y.shape[1] == 4
+
+
+def test_model_ref_resolves_from_other_process_and_cwd(tmp_path):
+    """file:// MODEL-REF published by this process resolves from a
+    different process running in a different cwd — the trainer-here /
+    serving-there contract (reference: BatchUpdateFunction.java:103-130
+    reads the shared filesystem from whichever host runs the layer)."""
+    doc = pmml_io.build_skeleton_pmml()
+    pmml_io.add_extension(doc, "features", 3)
+    model_uri = f"file://{tmp_path}/models/123/model.pmml.xml"
+    pmml_io.write(doc, model_uri)
+
+    other_cwd = tmp_path / "elsewhere"
+    other_cwd.mkdir()
+    code = (
+        "from oryx_tpu.app.pmml_utils import read_pmml_from_update_key_message\n"
+        "from oryx_tpu.common import pmml as pmml_io\n"
+        f"doc = read_pmml_from_update_key_message('MODEL-REF', {str(model_uri)!r})\n"
+        "assert doc is not None\n"
+        "print(pmml_io.get_extension_value(doc, 'features'))\n")
+    out = subprocess.run(
+        [sys.executable, "-c", code], cwd=other_cwd, capture_output=True,
+        text=True, env={**os.environ, "PYTHONPATH": os.getcwd()})
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip() == "3"
+
+
+def test_missing_model_ref_is_tolerated():
+    from oryx_tpu.app.pmml_utils import read_pmml_from_update_key_message
+    assert read_pmml_from_update_key_message(
+        "MODEL-REF", "memory://lake/model/nope.pmml.xml") is None
