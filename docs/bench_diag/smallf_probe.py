@@ -1,0 +1,109 @@
+"""Round-5 diagnostic: where does the F=50 serving scan lose 10x?
+
+BENCH_GRID_r04: 50f cells run 7.5-40 GB/s effective while 250f/5M hits
+872; 50f/20M per-tile cost is ~10.3 us vs 5.1 us at 250f/20M — MORE
+time for 5x less data.  This probe isolates, on the real chip:
+
+  1. raw HBM read of the (N, 50) bf16 array (its tiled layout pads the
+     50-lane minor dim to 128 — is the padding the ceiling?)
+  2. phase A (pallas fused dot+blockmax) alone, at T=4096 (current),
+     8192, and with a multi-subtile kernel
+  3. phase B alone
+  4. the same at F=250 for reference
+
+Usage: python docs/bench_diag/smallf_probe.py [--items 20] [--f 50,250]
+"""
+
+import argparse
+import json
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, "/root/repo")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from oryx_tpu.bench.kernel_probe import time_exec
+
+
+def phase_a(Y, Q, penalty, T, bs):
+    from jax.experimental import pallas as pl
+    N, F = Y.shape
+    B = Q.shape[0]
+
+    def kern(q_ref, y_ref, p_ref, o_ref):
+        s = jax.lax.dot_general(y_ref[...], q_ref[...],
+                                (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s3 = s.reshape(T // bs, bs, B) + p_ref[...][:, :, None]
+        o_ref[...] = s3.max(1)
+
+    return pl.pallas_call(
+        kern, grid=(N // T,),
+        in_specs=[pl.BlockSpec((B, F), lambda i: (0, 0)),
+                  pl.BlockSpec((T, F), lambda i: (i, 0)),
+                  pl.BlockSpec((T // bs, bs), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((T // bs, B), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((N // bs, B), jnp.float32),
+    )(Q, Y, penalty)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--items", type=float, default=20)
+    ap.add_argument("--f", default="50,250")
+    ap.add_argument("--batch", type=int, default=256)
+    args = ap.parse_args()
+
+    B = args.batch
+    bs = 128
+    N = int(args.items * 1e6) // 8192 * 8192
+    key = jax.random.PRNGKey(0)
+    out = {"N": N, "B": B, "results": {}}
+
+    for F in [int(x) for x in args.f.split(",")]:
+        Y = jax.device_put(jax.random.normal(key, (N, F), jnp.bfloat16))
+        Q = jax.device_put(jax.random.normal(key, (B, F), jnp.bfloat16))
+        penalty = jax.device_put(jnp.zeros((N // bs, bs), jnp.float32))
+        jax.device_get(jnp.sum(Y[:8]))  # materialize
+        res = {}
+        gb = N * F * 2 / 1e9
+
+        # 1. raw read: sum-reduce the whole array
+        red = jax.jit(lambda y: jnp.sum(y.astype(jnp.float32), axis=0))
+        t = time_exec(lambda: red(Y), jax.device_get)
+        res["raw_read"] = {**t, "gbps": round(gb / (t["exec_ms"] / 1e3), 1)}
+
+        # 2. phase A at several tile sizes
+        for T in (4096, 8192):
+            try:
+                fn = jax.jit(partial(phase_a, T=T, bs=bs))
+                t = time_exec(lambda: fn(Y, Q, penalty), jax.device_get)
+                res[f"phase_a_T{T}"] = {
+                    **t, "gbps": round(gb / (t["exec_ms"] / 1e3), 1)}
+            except Exception as e:  # noqa: BLE001
+                res[f"phase_a_T{T}"] = {"error": str(e)[:200]}
+
+        # 3. the full two-phase kernel (phase A+B) as served
+        from oryx_tpu.app.als import serving_model as sm
+        full = partial(sm._batch_top_n_twophase_pallas, k=16, bs=bs,
+                       ksel=32, max_bits=0)
+        pen1 = penalty
+        t = time_exec(
+            lambda: full(Y, Q.astype(jnp.bfloat16), pen1,
+                         jnp.ones((N,), bool), None, None),
+            jax.device_get)
+        res["full_twophase"] = {**t,
+                               "gbps": round(gb / (t["exec_ms"] / 1e3), 1)}
+
+        out["results"][f"F{F}"] = res
+        print(json.dumps({f"F{F}": res}), flush=True)
+
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

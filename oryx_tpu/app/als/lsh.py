@@ -118,8 +118,12 @@ class LocalitySensitiveHash:
         input may be the serving model's whole resident item matrix)."""
         if self.num_hashes == 0:
             return jnp.zeros(vectors.shape[0], dtype=jnp.int32)
-        return _bucket_kernel(vectors, self._device_hyperplanes(),
-                              self.num_hashes)
+        hp = self._device_hyperplanes()
+        if hp.shape[1] != vectors.shape[1]:
+            # lane-padded device snapshot: zero hyperplane columns keep
+            # every sign bit identical
+            hp = jnp.pad(hp, [(0, 0), (0, vectors.shape[1] - hp.shape[1])])
+        return _bucket_kernel(vectors, hp, self.num_hashes)
 
     def candidate_mask(self, query_vector: np.ndarray,
                        item_buckets: jax.Array) -> jax.Array:

@@ -70,14 +70,18 @@ _CHUNKED_BATCH = 256
 
 
 def _q_cast(Q, Y):
-    """Match the query operand's dtype to a bfloat16-stored factor
-    matrix.  A mixed f32 x bf16 matmul promotes BOTH operands to f32
-    and runs at the MXU's f32 rate (~1/4 of bf16); casting the query
-    keeps the scan on the native bf16 path with f32 accumulation
-    (kernel-only timings per cell: BENCH_GRID_r04.json device_exec_ms).
-    Score precision is unchanged in substance:
-    the factors are already bf16-quantized in HBM, and products of two
-    bf16 values are exact in the f32 accumulator."""
+    """Match the query operand to a stored factor matrix: dtype and
+    lane-padded width.  A mixed f32 x bf16 matmul promotes BOTH
+    operands to f32 and runs at the MXU's f32 rate (~1/4 of bf16);
+    casting the query keeps the scan on the native bf16 path with f32
+    accumulation.  The store's device snapshot zero-pads features
+    under 128 to the TPU's lane width (FeatureVectorStore.device_features
+    — sub-width tiles measured ~2x slower); the query's trailing dim is
+    zero-padded to match, which leaves every dot product bit-identical
+    (0-column contributions are exactly 0 in the f32 accumulator)."""
+    fp = Y.shape[-1]
+    if Q.shape[-1] != fp:
+        Q = jnp.pad(Q, [(0, 0)] * (Q.ndim - 1) + [(0, fp - Q.shape[-1])])
     return Q.astype(Y.dtype) if Y.dtype == jnp.bfloat16 else Q
 
 
@@ -90,6 +94,8 @@ def _dot_scores(Y, x):
 def _cosine_mean_scores(Y, V):
     """Mean cosine similarity of each row of Y to each column vector in V
     (reference: CosineAverageFunction.java:25)."""
+    if V.shape[0] != Y.shape[1]:  # lane-padded snapshot: pad V's rows
+        V = jnp.pad(V, [(0, Y.shape[1] - V.shape[0]), (0, 0)])
     # bf16-stored factors: norms must accumulate in f32 like the dot
     # kernels do, or 250-term squared sums lose ~1% per item norm
     Y = Y.astype(jnp.float32)

@@ -173,7 +173,11 @@ def test_feature_store_incremental_device_sync():
     fs.set_vector("v3", [99.0, 99.0])  # single dirty row -> scatter path
     v2, _ = fs.device_arrays()
     row = fs.row_of("v3")
-    np.testing.assert_array_equal(np.asarray(v2)[row], [99.0, 99.0])
+    # device snapshot is lane-padded to 128 features; the true columns
+    # carry the update and the padding stays exactly zero
+    assert v2.shape[1] == fs.device_features == 128
+    np.testing.assert_array_equal(np.asarray(v2)[row][:2], [99.0, 99.0])
+    assert not np.asarray(v2)[row][2:].any()
 
 
 # -- LSH --------------------------------------------------------------------
