@@ -60,13 +60,29 @@ def _pad_k(k: int) -> int:
 _FLAT_SCORES_LIMIT = 1 << 30
 _MAX_CHUNK_ROWS = 1 << 17
 
-# The chunked path pads every request batch to this fixed size and
+# The chunked path pads every request batch to a fixed window size and
 # splits bigger drains into windows of it.  Streaming the item matrix
 # from HBM dominates the dispatch up to roughly B = peak_flops /
-# memory_bw (~240 on v5e), so one fixed batch shape costs the same
-# device time as pow2 buckets would — and the 20M x 250 scan kernel
-# compiles ONCE instead of once per drain-size bucket.
+# memory_bw (~240 on v5e), so the full window costs the same device
+# time as pow2 buckets would — and the 20M x 250 scan kernel compiles
+# once per LADDER size, not once per drain-size bucket.  The ladder's
+# small windows exist for latency: the per-window cost has a large
+# B-proportional VPU component (the block-max reduce), so an idle
+# server's lone request on an 8-window pays a few ms instead of the
+# full 256-window's tens (VERDICT r04: the 50f/20M LSH cell's unloaded
+# p50 lost to the baseline purely on window padding).
 _CHUNKED_BATCH = 256
+_WINDOW_LADDER = (8, 32, 256)
+
+
+def _window_sizes(n: int) -> list[int]:
+    """Static window shapes covering an ``n``-query drain: full windows
+    plus one ladder window that fits the tail."""
+    out = [_CHUNKED_BATCH] * (n // _CHUNKED_BATCH)
+    tail = n % _CHUNKED_BATCH
+    if tail:
+        out.append(next(w for w in _WINDOW_LADDER if w >= tail))
+    return out
 
 
 def _q_cast(Q, Y):
@@ -260,6 +276,34 @@ def _pallas_error_is_fatal(e: Exception) -> bool:
     text = f"{type(e).__name__} {e}".lower()
     return isinstance(e, NotImplementedError) or any(
         m.lower() in text for m in _PALLAS_FATAL_MARKERS)
+
+
+def _classify_pallas_failure(keys: list, e: Exception) -> None:
+    """Record a pallas dispatch/fetch failure against the given shape
+    keys: fatal (lowering/unsupported) retires them to the scan build;
+    transient failures count toward the 3-strike retirement.  A failure
+    attributed only to shapes that all worked before re-raises — that
+    is a real runtime failure, not a fallback case."""
+    fresh = [k for k in keys if _PALLAS_STATE.get(k) != "ok"]
+    if not fresh:
+        raise e
+    if _pallas_error_is_fatal(e):
+        for k in fresh:
+            _PALLAS_STATE[k] = "broken"
+        _log.warning(
+            "pallas two-phase kernel unavailable for shape(s) %s "
+            "(serving falls back to the lax.scan build, ~4x slower at "
+            "20M items): %s", fresh, e)
+    else:
+        # e.g. a device OOM from a concurrent dispatch: leave the
+        # kernel eligible for the next drain
+        for k in fresh:
+            fails = _PALLAS_STATE.get(k, 0) + 1
+            _PALLAS_STATE[k] = ("broken" if fails >= _PALLAS_MAX_TRANSIENT
+                                else fails)
+        _log.warning(
+            "pallas two-phase dispatch failed transiently for "
+            "shape(s) %s (3 strikes retires a shape): %s", fresh, e)
 
 
 @partial(jax.jit, static_argnames=("k", "bs", "ksel", "max_bits",
@@ -579,10 +623,13 @@ class ALSServingModel(FactorModelBase, ServingModel):
                 else None
             hp = self.lsh._device_hyperplanes() if lsh_on else None
             mb = self.lsh.max_bits_differing if lsh_on else 0
-            jax.device_get(_batch_top_n_chunked_kernel(
-                vecs,
-                jnp.zeros((_CHUNKED_BATCH, self.features), jnp.float32),
-                active, buckets, hp, k, chunk, mb))
+            for w in _WINDOW_LADDER:
+                # exact-scan fallback per ladder window shape, so a rare
+                # certificate failure costs one extra dispatch, never an
+                # in-request XLA compile
+                jax.device_get(_batch_top_n_chunked_kernel(
+                    vecs, jnp.zeros((w, self.features), jnp.float32),
+                    active, buckets, hp, k, chunk, mb))
 
     def _cached_penalty(self, active, version) -> jax.Array:
         """Lane-aligned (N//128, 128) f32 additive mask (0 for live
@@ -705,28 +752,32 @@ class ALSServingModel(FactorModelBase, ServingModel):
         vecs, active, version = self.Y.device_arrays_versioned()
         n_rows = int(vecs.shape[0])
         k = min(_pad_k(max(h + len(e) for h, e in zip(hm, excl))), n_rows)
-        # floor of 8: a (1,F)x(F,N) matvec hits a much slower XLA path
-        # than a small batched matmul, and zero rows are free
+        # pow2 floor of 8 for the FLAT path sizing decision: a
+        # (1,F)x(F,N) matvec hits a much slower XLA path than a small
+        # batched matmul, and zero rows are free
         b_pad = 1 << max(3, (n_req - 1).bit_length())
-        if b_pad != n_req:
-            Q = np.concatenate(
-                [Q, np.zeros((b_pad - n_req, Q.shape[1]), np.float32)])
         lsh_on = use_lsh and self._lsh_active()
         buckets = self._cached_buckets(vecs, version) if lsh_on else None
         big, chunk = _stream_plan(n_rows, b_pad)
         bs = _BLOCK_ROWS
         ksel = min(_BLOCK_KSEL, n_rows // max(1, bs))
         if big and n_rows % chunk == 0 and k <= chunk:
-            # streaming path: fixed batch shape, oversize drains become
-            # windows whose dispatches overlap (async) before ONE fetch
+            # streaming path: static window shapes from the ladder
+            # (computed from the TRUE request count — a 257-query drain
+            # is [256, 8], not two full windows), dispatched async
+            # before ONE fetch
             hp = self.lsh._device_hyperplanes() if lsh_on else None
             mb = self.lsh.max_bits_differing if lsh_on else 0
-            if Q.shape[0] < _CHUNKED_BATCH:
+            sizes = _window_sizes(n_req)
+            padded = sum(sizes)
+            if n_req < padded:
                 Q = np.concatenate(
-                    [Q, np.zeros((_CHUNKED_BATCH - Q.shape[0], Q.shape[1]),
+                    [Q, np.zeros((padded - n_req, Q.shape[1]),
                                  np.float32)])
-            windows = [jnp.asarray(Q[w:w + _CHUNKED_BATCH])
-                       for w in range(0, Q.shape[0], _CHUNKED_BATCH)]
+            windows, w = [], 0
+            for size in sizes:
+                windows.append(jnp.asarray(Q[w:w + size]))
+                w += size
             if n_rows % bs == 0 and 1 <= ksel < n_rows // bs \
                     and k <= ksel * bs:
                 fetched = self._dispatch_twophase(
@@ -753,6 +804,9 @@ class ALSServingModel(FactorModelBase, ServingModel):
             top_scores = np.concatenate([f[0] for f in fetched])
             top_idx = np.concatenate([f[1] for f in fetched])
         else:
+            if b_pad != n_req:
+                Q = np.concatenate(
+                    [Q, np.zeros((b_pad - n_req, Q.shape[1]), np.float32)])
             Qd = jnp.asarray(Q)
             if lsh_on:
                 out_dev = _batch_top_n_lsh_kernel(
@@ -775,47 +829,57 @@ class ALSServingModel(FactorModelBase, ServingModel):
         """Dispatch every window's two-phase program (async) and fetch
         once.  Prefers the pallas phase-A build (scores never leave
         VMEM; measured ~3x faster end-to-end on the 20M cells); falls
-        back permanently to the lax.scan build on backends where pallas
-        cannot lower (plain CPU) or on any compile failure."""
+        back to the lax.scan build per WINDOW SHAPE on backends where
+        pallas cannot lower (plain CPU) or on a compile failure — a
+        drain may mix full windows and one small tail window, and each
+        shape stands or falls alone."""
         n_rows = int(vecs.shape[0])
-        key = (n_rows, int(vecs.shape[1]), int(windows[0].shape[0]),
-               str(vecs.dtype), buckets is not None, k, mb)
-        if _PALLAS_STATE.get(key) != "broken" and n_rows % _PA_TILE == 0:
-            penalty = self._cached_penalty(active, version)
-            try:
-                out = jax.device_get([
-                    _batch_top_n_twophase_pallas(vecs, qw, penalty,
-                                                 active, buckets, hp, k,
-                                                 bs, ksel, mb)
-                    for qw in windows])
-                _PALLAS_STATE[key] = "ok"
-                return out
-            except Exception as e:  # noqa: BLE001 — classified below
-                if _PALLAS_STATE.get(key) == "ok":
-                    raise  # it worked before: a real runtime failure
-                if _pallas_error_is_fatal(e):
-                    _PALLAS_STATE[key] = "broken"
-                    _log.warning(
-                        "pallas two-phase kernel unavailable for shape "
-                        "%s (serving falls back to the lax.scan build, "
-                        "~4x slower at 20M items): %s", key, e)
-                else:
-                    # transient (device OOM, interrupted transfer, ...):
-                    # serve this drain on the scan build but leave the
-                    # kernel eligible for the next dispatch
-                    fails = _PALLAS_STATE.get(key, 0) + 1
-                    _PALLAS_STATE[key] = (
-                        "broken" if fails >= _PALLAS_MAX_TRANSIENT
-                        else fails)
-                    _log.warning(
-                        "pallas two-phase dispatch failed transiently "
-                        "for shape %s (%d/%d before retiring the "
-                        "kernel): %s", key, fails, _PALLAS_MAX_TRANSIENT,
-                        e)
-        return jax.device_get([
-            _batch_top_n_twophase_kernel(vecs, qw, active, buckets, hp,
-                                         k, chunk, bs, ksel, mb)
-            for qw in windows])
+        eligible = n_rows % _PA_TILE == 0
+
+        def key_of(qw):
+            return (n_rows, int(vecs.shape[1]), int(qw.shape[0]),
+                    str(vecs.dtype), buckets is not None, k, mb)
+
+        def scan_handle(qw):
+            return _batch_top_n_twophase_kernel(vecs, qw, active, buckets,
+                                                hp, k, chunk, bs, ksel,
+                                                mb)
+
+        penalty = None
+        handles, attempted = [], []
+        for qw in windows:
+            key = key_of(qw)
+            if eligible and _PALLAS_STATE.get(key) != "broken":
+                if penalty is None:
+                    penalty = self._cached_penalty(active, version)
+                try:
+                    handles.append(_batch_top_n_twophase_pallas(
+                        vecs, qw, penalty, active, buckets, hp, k, bs,
+                        ksel, mb))
+                    attempted.append(key)
+                    continue
+                except Exception as e:  # noqa: BLE001 — classified
+                    # compile/lowering failures surface here, at
+                    # dispatch, attributed to exactly this shape; a
+                    # shape that worked before re-raises
+                    _classify_pallas_failure([key], e)
+            handles.append(scan_handle(qw))
+        try:
+            out = jax.device_get(handles)  # ONE fetch for the drain
+        except Exception as e:  # noqa: BLE001 — classified below
+            fresh = [kk for kk in attempted
+                     if _PALLAS_STATE.get(kk) != "ok"]
+            if not fresh:
+                raise  # every shape worked before: real runtime failure
+            # a batched fetch cannot attribute the failure to one
+            # window; classify the not-yet-proven shapes (the transient
+            # 3-strike counter protects an innocent shape from a single
+            # misattribution) and serve the drain on the scan build
+            _classify_pallas_failure(fresh, e)
+            return jax.device_get([scan_handle(qw) for qw in windows])
+        for kk in attempted:
+            _PALLAS_STATE[kk] = "ok"
+        return out
 
     def _sharded_top_n_batch(self, hm: list[int], Q: np.ndarray,
                              excl: list[set[str]],
