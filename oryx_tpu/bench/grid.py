@@ -121,7 +121,8 @@ def bench_config(features: int, items_m: int, model, user_ids,
     from ..serving import als as als_resources
     from ..serving import framework as framework_resources
     from ..serving.batcher import TopNBatcher
-    from .load import StaticModelManager, run_recommend_load
+    from .load import (StaticModelManager, run_recommend_load,
+                       run_recommend_open_loop)
 
     StaticModelManager.model = model
     rows = []
@@ -163,6 +164,29 @@ def bench_config(features: int, items_m: int, model, user_ids,
             n_req = max(512, int(cal.qps * MEASURE_SEC))
             sat = run_recommend_load(base, user_ids, requests=n_req,
                                      workers=SAT_WORKERS, how_many=TOP_N)
+            # OPEN-LOOP capacity ladder (reference: TrafficUtil.java:63
+            # exponential inter-arrival): the closed-loop number above
+            # is bounded by workers/RTT through the device tunnel; the
+            # open-loop run offers a fixed arrival rate and measures
+            # whether the server sustains it, latency counted from the
+            # scheduled arrival.  Ladder rungs are fractions of the
+            # kernel ceiling capped by the measured ~8k req/s host path
+            # of this 1-core box.
+            ceiling = min(probe.get(next(
+                (p for p in ("twophase_pallas", "twophase", "flat_lsh",
+                             "flat", "chunked_exact") if p in probe),
+                ""), {}).get("qps_ceiling") or 8000.0, 8000.0)
+            open_loop = []
+            for frac in (0.25, 0.5, 0.75):
+                rate = max(50.0, ceiling * frac)
+                open_loop.append(run_recommend_open_loop(
+                    base, user_ids, rate_qps=rate, duration_sec=6.0,
+                    workers=SAT_WORKERS, how_many=TOP_N))
+                if not open_loop[-1]["sustained"]:
+                    break
+            sustained = [o["offered_qps"] for o in open_loop
+                         if o["sustained"]]
+            open_loop_capacity = max(sustained) if sustained else 0.0
             # snapshot drain/pacing state NOW, while it reflects the
             # saturation run (the unloaded probes below would pollute
             # the recent-batch window with 1-3 request drains)
@@ -194,6 +218,12 @@ def bench_config(features: int, items_m: int, model, user_ids,
             "lsh": lsh_on,
             "qps": round(sat.qps, 1),
             "qps_errors": sat.errors,
+            # closed-loop qps above is tunnel-bound (workers/RTT); the
+            # open-loop rows measure the SERVER at offered arrival
+            # rates (TrafficUtil-style), and open_loop_sustained_qps is
+            # the highest offered rate it sustained at >=95% completion
+            "open_loop": open_loop,
+            "open_loop_sustained_qps": open_loop_capacity,
             "p50_ms_at_2_workers": low["p50_ms"],
             "p95_ms_saturated": round(sat.percentile_ms(95), 1),
             "unloaded_latency_ms": unloaded,
@@ -220,6 +250,84 @@ def bench_config(features: int, items_m: int, model, user_ids,
     return rows
 
 
+def host_loopback_capacity() -> dict:
+    """The serving host path with the device taken out: a stub scorer
+    answers instantly, so closed-loop 512-worker qps and an open-loop
+    ladder measure HTTP parse + route + batcher + JSON encode on this
+    host alone.  Server capacity for a cell is then
+    min(host_loopback, that cell's kernel ceiling) — the decomposition
+    that separates server capacity from tunnel-bound closed-loop qps."""
+    from ..lambda_rt.http import HttpApp, make_server
+    from ..serving import als as als_resources
+    from ..serving import framework as framework_resources
+    from .load import (StaticModelManager, run_recommend_load,
+                       run_recommend_open_loop)
+
+    from ..app.als.serving_model import ALSServingModel
+
+    class StubModel(ALSServingModel):
+        # passes the route's isinstance gate but never touches a
+        # device: every method the /recommend path calls is overridden
+        features = 8
+        rescorer_provider = None
+        _result = [(f"i{j}", 1.0 - j / 100.0) for j in range(TOP_N)]
+
+        def __init__(self):  # noqa: D401 — no stores, no jax
+            pass
+
+        def get_fraction_loaded(self):
+            return 1.0
+
+        def get_user_vector(self, _id):
+            return np.zeros(8, np.float32)
+
+        def get_known_items(self, _id):
+            return set()
+
+        def top_n(self, how_many, **_kw):
+            return self._result[:how_many]
+
+        def top_n_batch(self, how_many, vectors, exclude=None,
+                        use_lsh=True):
+            hm = [how_many] * len(vectors) \
+                if isinstance(how_many, int) else how_many
+            return [self._result[:h] for h in hm]
+
+    StaticModelManager.model = StubModel()
+    app = HttpApp(
+        framework_resources.ROUTES + als_resources.ROUTES,
+        context={"model_manager": StaticModelManager(),
+                 "input_producer": None, "config": None,
+                 "min_model_load_fraction": 0.0,
+                 "top_n_batcher": None},
+        read_only=True)
+    server = make_server(app, 0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{port}"
+    user_ids = [f"u{i}" for i in range(256)]
+    try:
+        closed = run_recommend_load(base, user_ids, requests=20_000,
+                                    workers=64, how_many=TOP_N)
+        rate, sustained = closed.qps, []
+        ladder = []
+        for frac in (0.5, 0.75, 0.9):
+            o = run_recommend_open_loop(base, user_ids,
+                                        rate_qps=rate * frac,
+                                        duration_sec=5.0, workers=128,
+                                        how_many=TOP_N)
+            ladder.append(o)
+            if o["sustained"]:
+                sustained.append(o["offered_qps"])
+    finally:
+        server.shutdown()
+    return {
+        "closed_loop_qps": round(closed.qps, 1),
+        "open_loop": ladder,
+        "open_loop_sustained_qps": max(sustained) if sustained else 0.0,
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--items", default="1,5,20")
@@ -234,6 +342,8 @@ def main() -> None:
 
     floor = measure_tunnel_floor()
     print(json.dumps({"tunnel_floor_ms": round(floor, 1)}), flush=True)
+    host_cap = host_loopback_capacity()
+    print(json.dumps({"host_loopback": host_cap}), flush=True)
     all_rows = []
     for items_m in items_list:
         for features in features_list:
@@ -249,6 +359,7 @@ def main() -> None:
     grid_doc = {
         "metric": "als_recommend_http_grid",
         "tunnel_floor_ms": round(floor, 1),
+        "host_loopback": host_cap,
         "rows": all_rows,
         "note": ("unloaded_latency_ms: idle server, 1-3 workers (the "
                  "baseline's concurrency regime), measured after the "

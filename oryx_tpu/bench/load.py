@@ -30,7 +30,7 @@ from ..common.rand import RandomManager
 _log = logging.getLogger(__name__)
 
 __all__ = ["StaticModelManager", "build_load_test_model", "LoadStats",
-           "run_recommend_load"]
+           "run_recommend_load", "run_recommend_open_loop"]
 
 
 class StaticModelManager(ServingModelManager):
@@ -209,3 +209,127 @@ def run_recommend_load(base_url: str, user_ids: list[str],
     return LoadStats(requests=len(latencies), errors=errors[0],
                      elapsed_sec=elapsed,
                      latencies_ms=np.asarray(latencies))
+
+
+def run_recommend_open_loop(base_url: str, user_ids: list[str],
+                            rate_qps: float, duration_sec: float = 6.0,
+                            workers: int = 512, how_many: int = 10,
+                            timeout_sec: float = 30.0) -> dict:
+    """OPEN-LOOP /recommend driver: requests arrive on an exponential
+    inter-arrival schedule at ``rate_qps`` regardless of responses, and
+    latency is measured from the SCHEDULED arrival time — so queueing
+    delay when the server falls behind counts against it (reference:
+    TrafficUtil.java:63, exponential inter-arrival against live hosts).
+    A closed-loop client bounded by transport RTT measures the
+    transport; this measures the server.  Saturation shows as achieved
+    qps below offered and a growing scheduled-to-completion tail."""
+    rng = RandomManager.random()
+    n = max(1, int(rate_qps * duration_sec))
+    arrivals = np.cumsum(rng.exponential(1.0 / rate_qps, n))
+    picks = rng.integers(0, len(user_ids), n)
+    parsed = urllib.parse.urlparse(base_url)
+    host, port = parsed.hostname, parsed.port
+    path_prefix = parsed.path.rstrip("/")
+    latencies: list[float] = []
+    lateness: list[float] = []
+    errors = [0]
+    lock = threading.Lock()
+    next_index = [0]
+    t0 = time.perf_counter()
+
+    def worker():
+        import socket
+
+        conn = rfile = None
+
+        def connect():
+            nonlocal conn, rfile
+            conn = socket.create_connection((host, port),
+                                            timeout=timeout_sec)
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            rfile = conn.makefile("rb")
+
+        def one(path: str) -> bool:
+            conn.sendall(f"GET {path} HTTP/1.1\r\nHost: a\r\n\r\n"
+                         .encode("latin-1"))
+            status_line = rfile.readline(65537)
+            if not status_line:
+                raise ConnectionError("closed")
+            status = int(status_line.split(b" ", 2)[1])
+            clen = 0
+            while True:
+                h = rfile.readline(65537)
+                if h in (b"\r\n", b"\n", b""):
+                    break
+                if h[:15].lower() == b"content-length:":
+                    clen = int(h[15:])
+            if clen:
+                remaining = clen
+                while remaining:
+                    got = rfile.read(remaining)
+                    if not got:
+                        raise ConnectionError("short body")
+                    remaining -= len(got)
+            return status == 200
+
+        try:
+            while True:
+                with lock:
+                    i = next_index[0]
+                    if i >= n:
+                        return
+                    next_index[0] += 1
+                scheduled = t0 + arrivals[i]
+                now = time.perf_counter()
+                if scheduled > now:
+                    time.sleep(scheduled - now)
+                late = max(0.0, time.perf_counter() - scheduled)
+                path = (f"{path_prefix}/recommend/{user_ids[picks[i]]}"
+                        f"?howMany={how_many}")
+                try:
+                    if conn is None:
+                        connect()
+                    ok = one(path)
+                except Exception:  # noqa: BLE001 — counted as error
+                    ok = False
+                    if conn is not None:
+                        try:
+                            conn.close()
+                        except OSError:
+                            pass
+                        conn = None
+                ms = (time.perf_counter() - scheduled) * 1000.0
+                with lock:
+                    lateness.append(late * 1000.0)
+                    if ok:
+                        latencies.append(ms)
+                    else:
+                        errors[0] += 1
+        finally:
+            if conn is not None:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t0
+    lat = np.asarray(latencies)
+    achieved = len(latencies) / elapsed if elapsed else 0.0
+    return {
+        "offered_qps": round(rate_qps, 1),
+        "achieved_qps": round(achieved, 1),
+        "errors": errors[0],
+        "p50_ms": round(float(np.percentile(lat, 50)), 1) if len(lat) else None,
+        "p95_ms": round(float(np.percentile(lat, 95)), 1) if len(lat) else None,
+        # mean time requests spent waiting for a free client slot past
+        # their scheduled arrival — the open-loop backlog signal
+        "mean_sched_lateness_ms": round(float(np.mean(lateness)), 1)
+        if lateness else None,
+        "sustained": achieved >= 0.95 * rate_qps and errors[0] == 0,
+    }

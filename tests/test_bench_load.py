@@ -96,3 +96,21 @@ def test_grid_bench_toy_scale(monkeypatch):
         assert np.isfinite(r["p50_ms_at_2_workers"])
     assert rows[0]["lsh"] is False and rows[1]["lsh"] is True
     assert model.lsh is not None  # restored after the exact rows
+
+
+def test_open_loop_driver(load_server):
+    """Open-loop /recommend driver (TrafficUtil.java:63 analog):
+    arrival-rate-driven, latency from scheduled arrival, saturation
+    visible as achieved < offered."""
+    from oryx_tpu.bench.load import run_recommend_open_loop
+
+    base = f"http://127.0.0.1:{load_server.port}"
+    user_ids = [str(u) for u in range(200)]
+    out = run_recommend_open_loop(base, user_ids, rate_qps=60.0,
+                                  duration_sec=1.5, workers=16)
+    assert out["errors"] == 0
+    assert out["achieved_qps"] > 0
+    assert set(out) >= {"offered_qps", "achieved_qps", "p50_ms",
+                        "p95_ms", "mean_sched_lateness_ms", "sustained"}
+    # a modest rate against an idle in-proc model must sustain
+    assert out["sustained"] is True
