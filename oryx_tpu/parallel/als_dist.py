@@ -27,6 +27,7 @@ broadcast.
 from __future__ import annotations
 
 import math
+import zlib
 from functools import partial
 from typing import NamedTuple
 
@@ -146,8 +147,17 @@ def train_als_distributed(ratings: ParsedRatings, features: int, lam: float,
                         np.zeros((0, k), np.float32))
     blocks = block_ratings(ratings, n_dev)
 
-    rng = np.random.default_rng(
-        RandomManager.random_seed() if seed is None else seed)
+    if seed is None:
+        if jax.process_count() > 1:
+            # multi-controller SPMD: device_put of the init requires
+            # the SAME host value on every process, and per-process RNG
+            # state differs — derive the seed from the (identical by
+            # contract) input instead
+            seed = zlib.crc32(np.ascontiguousarray(
+                ratings.values).tobytes()) & 0x7FFFFFFF
+        else:
+            seed = RandomManager.random_seed()
+    rng = np.random.default_rng(seed)
     Y0 = (rng.standard_normal((blocks.i_cols.shape[0], k))
           / math.sqrt(k)).astype(np.float32)
     Y0[blocks.n_items:] = 0.0  # padding rows must not leak into the Gramian
@@ -161,6 +171,15 @@ def train_als_distributed(ratings: ParsedRatings, features: int, lam: float,
     step = make_train_step(mesh, lam, alpha, implicit, axis)
     for _ in range(iterations):
         X, Y = step(X, Y, *args)
+    if jax.process_count() > 1:
+        # multi-host: a row-sharded factor is not fully addressable
+        # from any one process; replicate (one all-gather each) so
+        # every process fetches the complete model for PMML publish —
+        # the analog of the reference collecting factors to the driver
+        # (ALSUpdate.mfModelToPMML :430-473)
+        rep = jax.jit(lambda a: a,
+                      out_shardings=NamedSharding(mesh, P()))
+        X, Y = rep(X), rep(Y)
     Xh = np.asarray(X)[:blocks.n_users]
     Yh = np.asarray(Y)[:blocks.n_items]
     return ALSModel(ratings.user_ids, ratings.item_ids, Xh, Yh)

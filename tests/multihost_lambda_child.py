@@ -1,0 +1,127 @@
+"""Child process for the two-process FULL-LAMBDA multi-host IT.
+
+Each child is one "host" of a 2-process jax.distributed cluster.  BOTH
+run the real ``ALSUpdate.run_update`` over the global mesh (the
+training collectives are SPMD — every process must execute them), on
+identical seeded input.  Process 0 additionally:
+
+  - publishes the winning model to a shared ``file://`` broker's update
+    topic (the cross-process transport tested in test_deploy_cli), and
+  - boots a ``ServingLayer`` that replays that topic and answers a live
+    HTTP ``/recommend`` from the process-spanning-trained model.
+
+Prints LAMBDA_OK + a JSON payload on success; DISTRIBUTED_UNSUPPORTED
+when the platform cannot initialize a multi-process CPU cluster (the
+parent skips).  Reference analog: the batch layer training on a YARN
+cluster while the serving layer answers from the published model
+(SURVEY §2.14 P1/P3).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def main() -> None:
+    coord, pid, n_dev, repo, work = (sys.argv[1], int(sys.argv[2]),
+                                     int(sys.argv[3]), sys.argv[4],
+                                     sys.argv[5])
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    sys.path.insert(0, repo)
+    from oryx_tpu.common.config import from_dict
+    from oryx_tpu.parallel.mesh import initialize_multihost
+
+    cfg = from_dict({
+        "oryx.id": "mhlambda",
+        "oryx.distributed.coordinator-address": coord,
+        "oryx.distributed.num-processes": 2,
+        "oryx.distributed.process-id": pid,
+        # force the mesh over the virtual CPU devices (both processes)
+        "oryx.batch.streaming.master": "mesh",
+        "oryx.input-topic.broker": f"file://{work}/broker",
+        "oryx.input-topic.partitions": 1,
+        "oryx.input-topic.message.topic": "MhIn",
+        "oryx.update-topic.broker": f"file://{work}/broker",
+        "oryx.update-topic.message.topic": "MhUp",
+        "oryx.serving.model-manager-class":
+            "oryx_tpu.app.als.serving_manager.ALSServingModelManager",
+        "oryx.serving.application-resources": "oryx_tpu.serving.als",
+        "oryx.als.hyperparams.features": 4,
+        "oryx.als.implicit": True,
+        "oryx.als.iterations": 3,
+        "oryx.ml.eval.test-fraction": 0.0,
+        "oryx.ml.eval.candidates": 1,
+    })
+    try:
+        joined = initialize_multihost(cfg)
+    except Exception as e:  # noqa: BLE001 — env capability, not a bug
+        print("DISTRIBUTED_UNSUPPORTED", repr(e))
+        return
+    assert joined, "configured join returned False"
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 2 * n_dev, (len(jax.devices()), n_dev)
+
+    from oryx_tpu.app.als.update import ALSUpdate
+    from oryx_tpu.kafka.api import KEY_MODEL, KEY_MODEL_REF, KeyMessage
+    from oryx_tpu.kafka.inproc import InProcTopicProducer, resolve_broker
+
+    # identical seeded input in both processes: the training collectives
+    # are one SPMD program, so both hosts must run the same step stream
+    rng = np.random.default_rng(23)
+    data = [KeyMessage(None,
+                       f"u{rng.integers(40)},i{rng.integers(60)},1,{t}")
+            for t in range(600)]
+
+    update = ALSUpdate(cfg)
+    assert update.mesh is not None and update.mesh.devices.size == 2 * n_dev
+    if pid == 0:
+        broker = resolve_broker(f"file://{work}/broker")
+        producer = InProcTopicProducer(f"file://{work}/broker", "MhUp")
+        update.run_update(0, data, [], f"{work}/model0", producer)
+    else:
+        # same collectives, no publishing duties (the reference's
+        # executors train; only the driver writes the model)
+        update.run_update(0, data, [], f"{work}/model1", None)
+
+    payload = {"process": pid, "devices": len(jax.devices())}
+    if pid == 0:
+        msgs = list(broker.consume("MhUp", from_beginning=True,
+                                   max_idle_sec=0.2))
+        keys = [m.key for m in msgs]
+        assert KEY_MODEL in keys or KEY_MODEL_REF in keys, keys[:3]
+
+        import time
+        import urllib.request
+
+        from oryx_tpu.lambda_rt.serving import ServingLayer
+
+        serving = ServingLayer(cfg, port=0)
+        serving.start()
+        try:
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                m = serving.model_manager.get_model()
+                if m is not None and m.get_fraction_loaded() >= 0.8:
+                    break
+                time.sleep(0.1)
+            else:
+                raise AssertionError("serving model never loaded")
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{serving.port}/recommend/u1"
+                    f"?howMany=3", timeout=30) as r:
+                recs = json.loads(r.read())
+            assert len(recs) == 3 and all("id" in x for x in recs), recs
+            payload["recommend_ids"] = [x["id"] for x in recs]
+        finally:
+            serving.close()
+    print("LAMBDA_OK", json.dumps(payload))
+
+
+if __name__ == "__main__":
+    main()

@@ -70,3 +70,53 @@ def test_two_process_cluster_join_and_train():
     # same global checksum in both processes = the collective really
     # crossed the process boundary
     assert payloads[0]["checksum"] == payloads[1]["checksum"]
+
+
+@pytest.mark.slow
+def test_two_process_full_lambda_loop(tmp_path):
+    """The FULL lambda loop across a 2-process jax.distributed cluster:
+    both processes run the real ALSUpdate.run_update over the global
+    mesh; process 0 publishes to a shared file:// broker and a
+    ServingLayer answers a live /recommend from the process-spanning
+    model (VERDICT r04 item 5; reference analog: batch training on the
+    cluster, serving answering from the published model — SURVEY §2.14
+    P1/P3)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    child = os.path.join(repo, "tests", "multihost_lambda_child.py")
+    coord = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
+                          .replace("--xla_force_host_platform_device_count=8",
+                                   "")
+                          + f" --xla_force_host_platform_device_count"
+                            f"={_N_DEV}").strip())
+    procs = [subprocess.Popen(
+        [sys.executable, child, coord, str(pid), str(_N_DEV), repo,
+         str(tmp_path)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env, cwd=repo) for pid in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=_TIMEOUT_SEC)
+            outs.append((p.returncode, out, err))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.skip("multi-process cluster join timed out on this host")
+
+    for rc, out, err in outs:
+        if "DISTRIBUTED_UNSUPPORTED" in out:
+            pytest.skip(f"jax.distributed unsupported here: {out.strip()}")
+        assert rc == 0, f"child failed rc={rc}\nstdout:{out}\nstderr:{err}"
+        assert "LAMBDA_OK" in out, (out, err)
+
+    import json
+    payloads = [json.loads(out.split("LAMBDA_OK", 1)[1].strip())
+                for _, out, _ in outs]
+    by_pid = {p["process"]: p for p in payloads}
+    assert set(by_pid) == {0, 1}
+    assert all(p["devices"] == 2 * _N_DEV for p in payloads)
+    # the serving layer really answered from the cluster-trained model
+    assert len(by_pid[0]["recommend_ids"]) == 3
