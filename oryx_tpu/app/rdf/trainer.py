@@ -24,9 +24,10 @@ P/3 for regression), re-designed for TPU:
   vectorized equivalent of sampling with replacement.
 
 The output is host `DecisionTree`s (tree.py) — the mutable/serializable
-model form — with PMML record counts and feature importances computed
-by routing the full training set back through the compiled forest
-(forest_arrays.py), mirroring RDFUpdate.treeNodeExampleCounts /
+model form — with PMML record counts and feature importances collected
+LIVE per level from the frontier occupancy (every example's node is in
+slot_of already; re-routing the training set after the build measured
+44 s of a 72 s warm build), mirroring RDFUpdate.treeNodeExampleCounts /
 predictorExampleCounts.
 """
 
@@ -43,7 +44,6 @@ import numpy as np
 from ...common.rand import RandomManager
 from ..classreg import CategoricalPrediction, NumericPrediction
 from ..schema import InputSchema
-from .forest_arrays import ForestArrays
 from .tree import (CategoricalDecision, DecisionForest, DecisionNode,
                    DecisionTree, NumericDecision, TerminalNode)
 
@@ -59,6 +59,29 @@ IMPURITIES = ("gini", "entropy", "variance")
 # samples per matmul tile in the histogram scan; bounds the one-hot
 # slot matrix to [CHUNK, M] and the bin/class tensor to [CHUNK, P*S*C]
 _HIST_CHUNK = 1 << 16
+
+
+def _chunk_examples(num_b: int, cap: int, *arrays):
+    """Shared example-axis chunking for the level kernels: pick the
+    chunk size (small inputs must not pay for a full tile), pad the
+    example axis (slot arrays use -1 = settled as the pad sentinel),
+    and reshape each array to [n_chunks, ...].  Arrays are passed as
+    (array, example_axis, pad_value) triples."""
+    chunk = min(cap, 1 << max(0, (num_b - 1).bit_length()))
+    n_chunks = -(-num_b // chunk)
+    pad = n_chunks * chunk - num_b
+    out = []
+    for arr, axis, pad_value in arrays:
+        if pad:
+            widths = [(0, 0)] * arr.ndim
+            widths[axis] = (0, pad)
+            arr = jnp.pad(arr, widths, constant_values=pad_value)
+        if axis == 0:
+            out.append(arr.reshape((n_chunks, chunk) + arr.shape[1:]))
+        else:  # [T, B] -> [NC, T, CH]
+            out.append(jnp.moveaxis(
+                arr.reshape(arr.shape[0], n_chunks, chunk), 1, 0))
+    return chunk, out
 
 
 def _histogram_body(binned, ychan, w, slot_of, num_slots: int,
@@ -88,21 +111,9 @@ def _histogram_body(binned, ychan, w, slot_of, num_slots: int,
     num_c = ychan.shape[1]
     num_t = w.shape[0]
     dt = jnp.bfloat16 if exact_lowp else jnp.float32
-    # small inputs (speed-layer retrains, mesh shards) must not pay for
-    # a full 64k-row tile of one-hot/matmul work
-    chunk = min(_HIST_CHUNK, 1 << max(0, (num_b - 1).bit_length()))
-    n_chunks = -(-num_b // chunk)
-    pad = n_chunks * chunk - num_b
-    if pad:
-        binned = jnp.pad(binned, ((0, pad), (0, 0)))
-        ychan = jnp.pad(ychan, ((0, pad), (0, 0)))
-        w = jnp.pad(w, ((0, 0), (0, pad)))
-        slot_of = jnp.pad(slot_of, ((0, 0), (0, pad)),
-                          constant_values=-1)
-    br = binned.reshape(n_chunks, chunk, num_p)
-    yr = ychan.reshape(n_chunks, chunk, num_c)
-    wr = jnp.moveaxis(w.reshape(num_t, n_chunks, chunk), 1, 0)
-    sr = jnp.moveaxis(slot_of.reshape(num_t, n_chunks, chunk), 1, 0)
+    chunk, (br, yr, wr, sr) = _chunk_examples(
+        num_b, _HIST_CHUNK, (binned, 0, 0), (ychan, 0, 0.0),
+        (w, 1, 0.0), (slot_of, 1, -1))
 
     def chunk_step(acc, xs):
         b_c, y_c, w_c, s_c = xs      # [CH,P], [CH,C], [T,CH], [T,CH]
@@ -240,32 +251,128 @@ def _best_splits(hist, is_cat_p, feat_mask, impurity: str, k_features: int):
     return best_gain, best_p, best_b, default_right, right_mask, totals
 
 
+# samples per matmul tile in the advance scan; bounds the one-hot slot
+# matrix to [CHUNK, M] alongside the shared chunk of binned values
+_ADV_CHUNK = 1 << 16
+
+
 def _advance_body(slot_of, binned, split, best_p, best_b, is_cat_slot,
                   right_mask, child_slots):
     """Route samples to child slots (or settle them at leaves).
 
     slot_of [T, B], binned [B, P], split/best_p/best_b/is_cat_slot
     [T, M], right_mask [T, M, S], child_slots [T, M, 2] -> new [T, B]
-    """
-    def per_tree(slot_t, split_t, p_t, b_t, cat_t, rmask_t, child_t):
-        alive = slot_t >= 0
-        slot = jnp.where(alive, slot_t, 0)
-        feat = p_t[slot]                                  # [B]
-        bin_val = jnp.take_along_axis(binned, feat[:, None], axis=1)[:, 0]
-        numeric_right = bin_val > b_t[slot]
-        cat_right = jnp.take_along_axis(
-            rmask_t[slot], bin_val[:, None], axis=1)[:, 0]
-        went_right = jnp.where(cat_t[slot], cat_right, numeric_right)
-        child = jnp.take_along_axis(
-            child_t[slot], went_right[:, None].astype(jnp.int32),
-            axis=1)[:, 0]
-        return jnp.where(alive & split_t[slot], child, -1)
 
-    return jax.vmap(per_tree)(slot_of, split, best_p, best_b, is_cat_slot,
-                              right_mask, child_slots)
+    MXU formulation mirroring the histogram kernel: per-slot decision
+    data packs into one [M, 6+S] table fetched per example by a one-hot
+    matmul, and the per-example feature/bin selections are one-hot
+    contractions over P and S.  The straightforward per-example
+    take_along_axis gathers lower to TPU element gathers and measured
+    1.6 s PER LEVEL at bench scale (900k x 20 trees) — ~20x this form.
+    All values rounding through the f32 matmul are small exact
+    integers/booleans, so routing is bit-identical to the gather form.
+    """
+    num_t, num_b = slot_of.shape
+    num_p = binned.shape[1]
+    num_m = split.shape[1]
+    num_s = right_mask.shape[2]
+    table = jnp.concatenate([
+        split[:, :, None].astype(jnp.float32),
+        best_p[:, :, None].astype(jnp.float32),
+        best_b[:, :, None].astype(jnp.float32),
+        is_cat_slot[:, :, None].astype(jnp.float32),
+        child_slots.astype(jnp.float32),
+        right_mask.astype(jnp.float32),
+    ], axis=2)                                          # [T, M, 6+S]
+    _, (br, sr) = _chunk_examples(num_b, _ADV_CHUNK, (binned, 0, 0),
+                                  (slot_of, 1, -1))
+    p_iota = jnp.arange(num_p, dtype=jnp.float32)
+    s_iota = jnp.arange(num_s, dtype=jnp.float32)
+
+    def chunk_step(carry, xs):
+        b_c, s_c = xs                           # [CH, P], [T, CH]
+        bf = b_c.astype(jnp.float32)
+
+        def per_tree(slot_t, table_t):
+            alive = slot_t >= 0
+            oh = jax.nn.one_hot(jnp.where(alive, slot_t, 0), num_m,
+                                dtype=jnp.float32)       # [CH, M]
+            # HIGHEST precision: the TPU's default matmul pass
+            # truncates f32 operands to bfloat16, which rounds child
+            # slot ids above 256 — exact f32 passes keep every table
+            # value (ids up to 2*M) bit-exact
+            row = jnp.matmul(oh, table_t,
+                             precision=jax.lax.Precision.HIGHEST,
+                             preferred_element_type=jnp.float32)
+            feat, thr_b, cat = row[:, 1], row[:, 2], row[:, 3]
+            bin_val = jnp.sum(
+                jnp.where(feat[:, None] == p_iota[None, :], bf, 0.0),
+                axis=1)
+            numeric_right = bin_val > thr_b
+            cat_right = jnp.sum(
+                jnp.where(bin_val[:, None] == s_iota[None, :],
+                          row[:, 6:], 0.0), axis=1) > 0.5
+            went_right = jnp.where(cat > 0.5, cat_right, numeric_right)
+            child = jnp.where(went_right, row[:, 5], row[:, 4])
+            return jnp.where(alive & (row[:, 0] > 0.5),
+                             child.astype(jnp.int32), -1)
+
+        # lax.map (not vmap) over trees bounds peak memory to one
+        # [CH, M] one-hot at a time (histogram-kernel rationale)
+        out = jax.lax.map(lambda a: per_tree(*a), (s_c, table))
+        return carry, out
+
+    _, outs = jax.lax.scan(chunk_step, None, (br, sr))  # [NC, T, CH]
+    return jnp.moveaxis(outs, 0, 1).reshape(num_t, -1)[:, :num_b]
 
 
 _advance = jax.jit(_advance_body)
+
+
+def _slot_counts_body(slot_of, num_slots: int):
+    """Unweighted examples per (tree, slot): the node example counts
+    the reference derives by re-routing the FULL training set
+    (RDFUpdate.treeNodeExampleCounts) — here every example's node is
+    already in slot_of each level, so counts are one chunked one-hot
+    sum instead of a post-hoc 900k x trees re-route (measured 44 s of
+    a 72 s warm build before this)."""
+    num_t, num_b = slot_of.shape
+    _, (sr,) = _chunk_examples(num_b, _ADV_CHUNK, (slot_of, 1, -1))
+
+    def chunk_step(acc, s_c):
+        def per_tree(slot_t):
+            alive = slot_t >= 0
+            # int32 accumulation: counts are PMML record counts and
+            # must stay exact past 2^24 examples per node (f32 one-hot
+            # sums saturate there)
+            oh = jax.nn.one_hot(jnp.where(alive, slot_t, 0), num_slots,
+                                dtype=jnp.int32)
+            return jnp.sum(jnp.where(alive[:, None], oh, 0), axis=0)
+
+        return acc + jax.lax.map(per_tree, s_c), None
+
+    # seed the carry from input data (+0) so that under shard_map its
+    # varying-axes type matches the loop output's (histogram-kernel
+    # rationale: a device-invariant literal carry is rejected)
+    acc0 = jnp.zeros((num_t, num_slots), jnp.int32) + slot_of[0, 0] * 0
+    acc, _ = jax.lax.scan(chunk_step, acc0, sr)
+    return acc
+
+
+_slot_counts = partial(jax.jit, static_argnums=(1,))(_slot_counts_body)
+
+
+@lru_cache(maxsize=16)
+def _dist_slot_counts_fn(mesh, axis: str, num_slots: int):
+    """Sharded per-slot example counts: local one-hot sums + one psum."""
+    from jax.sharding import PartitionSpec as P
+
+    def body(slot_of):
+        local = _slot_counts_body(slot_of, num_slots)
+        return jax.lax.psum(local, axis)
+
+    return jax.jit(jax.shard_map(
+        body, mesh=mesh, in_specs=(P(None, axis),), out_specs=P()))
 
 
 @lru_cache(maxsize=16)
@@ -304,7 +411,8 @@ def train_forest(x: np.ndarray, y: np.ndarray, schema: InputSchema,
                  max_depth: int, max_split_candidates: int,
                  impurity: str, seed: int | None = None,
                  num_classes: int | None = None,
-                 mesh=None, mesh_axis: str = "d") -> DecisionForest:
+                 mesh=None, mesh_axis: str = "d",
+                 timings: dict | None = None) -> DecisionForest:
     """Train a forest on predictors ``x`` [B, P] (categorical values as
     encodings) and targets ``y`` (class encodings or regression values).
 
@@ -326,6 +434,19 @@ def train_forest(x: np.ndarray, y: np.ndarray, schema: InputSchema,
     if batch == 0:
         raise ValueError("no training data")
 
+    import time as _time
+
+    def _mark(stage: str, t0: float) -> float:
+        # optional stage-time decomposition for the bench artifact;
+        # device work is async, so each device_get absorbs pending
+        # kernel time into its stage
+        now = _time.perf_counter()
+        if timings is not None:
+            timings[stage] = timings.get(stage, 0.0) + (now - t0)
+        return now
+
+    t0 = _time.perf_counter()
+
     is_cat = np.zeros(num_p, dtype=bool)
     for p, count in category_counts.items():
         is_cat[p] = True
@@ -337,6 +458,7 @@ def train_forest(x: np.ndarray, y: np.ndarray, schema: InputSchema,
     num_bins = int(max_split_candidates)
     binned_np, thresholds = _bin_features(x, is_cat, num_bins)
     binned = jnp.asarray(binned_np)
+    t0 = _mark("bin_features", t0)
 
     if classification:
         if num_classes is None:
@@ -373,6 +495,7 @@ def train_forest(x: np.ndarray, y: np.ndarray, schema: InputSchema,
         ychan = jax.device_put(jnp.asarray(ychan), row)
         w = jax.device_put(w, col)
         slot_of = jax.device_put(slot_of, col)
+    t0 = _mark("init_upload", t0)
     # per-(tree, slot) node-ID strings for the current frontier
     frontier_ids = [["r"] for _ in range(num_trees)]
     # per-tree accumulated node records: id -> dict
@@ -404,14 +527,25 @@ def train_forest(x: np.ndarray, y: np.ndarray, schema: InputSchema,
             (num_trees, num_slots, num_p))
         gain, best_p, best_b, default_right, right_mask, totals = \
             _best_splits(hist, is_cat_j, feat_u, impurity, k_features)
+        # unweighted examples per frontier node — the PMML record
+        # counts, collected live instead of re-routing the training
+        # set after the build (treeNodeExampleCounts semantics)
+        if mesh is not None:
+            counts = _dist_slot_counts_fn(mesh, mesh_axis,
+                                          num_slots)(slot_of)
+        else:
+            counts = _slot_counts(slot_of, num_slots)
+        t0 = _mark("level_dispatch", t0)
 
-        # ONE host fetch for all six outputs: each np.asarray is a full
-        # device round trip, and behind a high-latency transport six
+        # ONE host fetch for all outputs: each np.asarray is a full
+        # device round trip, and behind a high-latency transport seven
         # of them per level dominate the (fast) kernels
-        gain, best_p_np, best_b_np, default_np, right_np, totals_np = \
-            jax.device_get((gain, best_p, best_b, default_right,
-                            right_mask, totals))
+        (gain, best_p_np, best_b_np, default_np, right_np, totals_np,
+         counts_np) = jax.device_get(
+            (gain, best_p, best_b, default_right, right_mask, totals,
+             counts))
         totals_np = np.asarray(totals_np, dtype=np.float64)
+        t0 = _mark("level_fetch", t0)
 
         # decide split vs leaf per (tree, slot) on host; assign child slots
         split_np = np.zeros((num_trees, num_slots), dtype=bool)
@@ -424,7 +558,8 @@ def train_forest(x: np.ndarray, y: np.ndarray, schema: InputSchema,
                     np.isfinite(gain[t, m])
                 if not do_split:
                     records[t][node_id] = {"leaf": True,
-                                           "stats": totals_np[t, m]}
+                                           "stats": totals_np[t, m],
+                                           "count": int(counts_np[t, m])}
                     continue
                 p = int(best_p_np[t, m])
                 split_np[t, m] = True
@@ -439,12 +574,14 @@ def train_forest(x: np.ndarray, y: np.ndarray, schema: InputSchema,
                                 float(thresholds[p, int(best_b_np[t, m])]))
                 records[t][node_id] = {
                     "leaf": False, "decision": decision,
-                    "default_right": bool(default_np[t, m])}
+                    "default_right": bool(default_np[t, m]),
+                    "count": int(counts_np[t, m])}
                 child_slots[t, m, 0] = len(next_ids[t])
                 next_ids[t].append(node_id + "-")
                 child_slots[t, m, 1] = len(next_ids[t])
                 next_ids[t].append(node_id + "+")
 
+        t0 = _mark("level_host_partition", t0)
         if not any(next_ids[t] for t in range(num_trees)):
             break
         advance = _advance if mesh is None \
@@ -453,22 +590,29 @@ def train_forest(x: np.ndarray, y: np.ndarray, schema: InputSchema,
                           best_p, best_b, jnp.asarray(is_cat_slot),
                           right_mask, jnp.asarray(child_slots))
         frontier_ids = next_ids
+        t0 = _mark("level_advance_dispatch", t0)
 
     forest = _build_forest(records, schema, classification,
                            num_classes if classification else 0)
-    _finalize_counts(forest, x, schema, classification,
-                     num_classes if classification else 0)
+    _mark("build_forest", t0)
     return forest
 
 
 def _build_forest(records, schema: InputSchema, classification: bool,
                   num_classes: int) -> DecisionForest:
-    """Reconstruct host trees from per-node training records."""
+    """Reconstruct host trees from per-node training records, carrying
+    the full-set example counts collected per level into PMML record
+    counts and feature importances (reference:
+    RDFUpdate.treeNodeExampleCounts / predictorExampleCounts — counts
+    come from routing EVERY example, not the bootstrap sample; leaf
+    distributions stay the bootstrap-weighted stats, rescaled)."""
     trees = []
+    importance_counts = np.zeros(schema.num_features, dtype=np.float64)
     for tree_records in records:
 
         def build(node_id: str):
             rec = tree_records[node_id]
+            count = rec.get("count", 0)
             if rec["leaf"]:
                 stats = rec["stats"]
                 if classification:
@@ -476,10 +620,13 @@ def _build_forest(records, schema: InputSchema, classification: bool,
                     if counts.sum() <= 0:
                         counts = np.ones(num_classes)
                     prediction = CategoricalPrediction(counts)
+                    probs = prediction.category_probabilities
+                    prediction.category_counts = probs * max(1, count)
+                    prediction.count = count
+                    prediction._recompute()
                 else:
                     n = max(stats[0], 1e-12)
-                    prediction = NumericPrediction(stats[1] / n,
-                                                   int(round(stats[0])))
+                    prediction = NumericPrediction(stats[1] / n, count)
                 return TerminalNode(node_id, prediction)
             kind, p, arg = rec["decision"]
             feature_number = schema.predictor_to_feature_index(p)
@@ -489,56 +636,17 @@ def _build_forest(records, schema: InputSchema, classification: bool,
             else:
                 decision = NumericDecision(feature_number, arg,
                                            rec["default_right"])
-            return DecisionNode(node_id, decision, build(node_id + "-"),
+            node = DecisionNode(node_id, decision, build(node_id + "-"),
                                 build(node_id + "+"))
+            node.count = count
+            importance_counts[feature_number] += count
+            return node
 
         trees.append(DecisionTree(build("r")))
-    return DecisionForest(trees)
-
-
-def _finalize_counts(forest: DecisionForest, x: np.ndarray,
-                     schema: InputSchema, classification: bool,
-                     num_classes: int) -> None:
-    """Set PMML record counts from the FULL training set (reference:
-    RDFUpdate.treeNodeExampleCounts routes every example, not the
-    bootstrap sample) and derive feature importances from per-decision
-    traversal counts (predictorExampleCounts)."""
-    # full-features matrix for routing (decisions use all-features idx)
-    full = np.full((x.shape[0], schema.num_features), np.nan,
-                   dtype=np.float32)
-    for p in range(schema.num_predictors):
-        full[:, schema.predictor_to_feature_index(p)] = x[:, p]
-    arrays = ForestArrays(forest, schema.num_features, num_classes)
-    terminal = arrays.route(full)                       # [T, B]
-
-    importance_counts = np.zeros(schema.num_features, dtype=np.float64)
-    for t, tree in enumerate(forest.trees):
-        leaf_counts: dict[str, int] = {}
-        ids, counts = np.unique(terminal[t], return_counts=True)
-        for i, c in zip(ids, counts):
-            leaf_counts[arrays.node_ids[t][i]] = int(c)
-
-        def fill(node) -> int:
-            if node.is_terminal:
-                count = leaf_counts.get(node.id, 0)
-                pred = node.prediction
-                if classification:
-                    probs = pred.category_probabilities
-                    pred.category_counts = probs * max(1, count)
-                    pred.count = count
-                    pred._recompute()
-                else:
-                    pred.count = count
-                return count
-            count = fill(node.left) + fill(node.right)
-            node.count = count
-            importance_counts[node.decision.feature_number] += count
-            return count
-
-        fill(tree.root)
-
+    forest = DecisionForest(trees)
     total = importance_counts.sum()
-    if total > 0:
-        forest.feature_importances = importance_counts / total
-    else:
-        forest.feature_importances = importance_counts
+    forest.feature_importances = (importance_counts / total if total > 0
+                                  else importance_counts)
+    return forest
+
+

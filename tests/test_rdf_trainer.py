@@ -88,3 +88,54 @@ def test_trainer_determinism_and_validation():
         train_forest(x, y, schema, {}, 2, 3, 8, "variance", seed=9)
     with pytest.raises(ValueError):
         train_forest(x, y, schema, {0: 100}, 2, 3, 8, "gini", seed=9)
+
+
+def test_advance_matches_numpy_oracle_at_wide_frontier():
+    """The MXU-formulated advance must route bit-identically to a plain
+    per-example walk, including child slot ids past 256 (a bf16-operand
+    matmul pass would round those — the fetch matmul must run exact
+    f32 passes)."""
+    import jax.numpy as jnp
+    from oryx_tpu.app.rdf.trainer import _advance_body
+
+    rng = np.random.default_rng(44)
+    T, B, P, M, S = 3, 5000, 6, 512, 16
+    slot_of = rng.integers(-1, M, (T, B)).astype(np.int32)
+    binned = rng.integers(0, S, (B, P)).astype(np.int32)
+    split = rng.random((T, M)) < 0.8
+    best_p = rng.integers(0, P, (T, M)).astype(np.int32)
+    best_b = rng.integers(0, S - 1, (T, M)).astype(np.int32)
+    is_cat = rng.random((T, M)) < 0.3
+    rmask = rng.random((T, M, S)) < 0.5
+    child = rng.integers(0, 2 * M, (T, M, 2)).astype(np.int32)
+
+    got = np.asarray(_advance_body(
+        jnp.asarray(slot_of), jnp.asarray(binned), jnp.asarray(split),
+        jnp.asarray(best_p), jnp.asarray(best_b), jnp.asarray(is_cat),
+        jnp.asarray(rmask), jnp.asarray(child)))
+
+    want = np.full((T, B), -1, np.int32)
+    for t in range(T):
+        for b in range(B):
+            s = slot_of[t, b]
+            if s < 0 or not split[t, s]:
+                continue
+            p = best_p[t, s]
+            v = binned[b, p]
+            right = rmask[t, s, v] if is_cat[t, s] else v > best_b[t, s]
+            want[t, b] = child[t, s, 1 if right else 0]
+    np.testing.assert_array_equal(got, want)
+
+
+def test_slot_counts_match_numpy():
+    import jax.numpy as jnp
+    from oryx_tpu.app.rdf.trainer import _slot_counts
+
+    rng = np.random.default_rng(45)
+    T, B, M = 4, 3000, 64
+    slot_of = rng.integers(-1, M, (T, B)).astype(np.int32)
+    got = np.asarray(_slot_counts(jnp.asarray(slot_of), M))
+    for t in range(T):
+        alive = slot_of[t][slot_of[t] >= 0]
+        want = np.bincount(alive, minlength=M)
+        np.testing.assert_array_equal(got[t], want)
