@@ -694,6 +694,23 @@ class ALSServingModel(FactorModelBase, ServingModel):
 
         exclude = set(exclude)
         if rescorer is not None or allowed is not None:
+            # device-side top-M, rescore the M candidates on host: the
+            # full score pull is ~80 MB per query at 20M items through
+            # whatever transport fronts the chip.  Falls back to the
+            # full pull only when filtering eats the whole window
+            # (reference: Recommend.java:91-107 streams every candidate
+            # through the rescorer; the window form trades that for a
+            # bounded fetch — a rescorer can only reorder/filter the
+            # top-M pre-rescore candidates unless the fallback runs).
+            n_rows = int(vecs.shape[0])
+            m = min(_pad_k(max(4 * (how_many + len(exclude)), 512)),
+                    n_rows)
+            if m < n_rows:
+                out = self._rescored_from_window(
+                    scores, mask, m, how_many, exclude, rescorer,
+                    allowed, lowest)
+                if out is not None:
+                    return out
             return self._host_top_n(np.asarray(scores), np.asarray(mask),
                                     how_many, exclude, rescorer, allowed,
                                     lowest)
@@ -928,6 +945,41 @@ class ALSServingModel(FactorModelBase, ServingModel):
                                  exclude=excl[b], use_lsh=use_lsh)
             results.append(out)
         return results
+
+    def _rescored_from_window(self, scores, mask, m: int, how_many: int,
+                              exclude: set[str],
+                              rescorer: Rescorer | None,
+                              allowed: Callable[[str], bool] | None,
+                              lowest: bool) -> list[tuple[str, float]] | None:
+        """Rescore/filter the device top-``m`` window; None when the
+        filters ate the window without filling ``how_many`` AND more
+        candidates exist beyond it (caller falls back to the full
+        pull).  A window that contained every live candidate is final
+        regardless of fill."""
+        ts, ti = jax.device_get(_masked_top_k(scores, mask, m))
+        out: list[tuple[str, float]] = []
+        exhausted = False
+        for s, i in zip(ts.tolist(), ti.tolist()):
+            if not math.isfinite(s):
+                exhausted = True  # -inf tail: no candidates remain
+                break
+            id_ = self.Y.id_of(int(i))
+            if id_ is None or id_ in exclude:
+                continue
+            if allowed is not None and not allowed(id_):
+                continue
+            score = -float(s) if lowest else float(s)
+            if rescorer is not None:
+                if rescorer.is_filtered(id_):
+                    continue
+                score = rescorer.rescore(id_, score)
+                if math.isnan(score):
+                    continue
+            out.append((id_, score))
+        if len(out) < how_many and not exhausted:
+            return None
+        out.sort(key=lambda t: t[1] if lowest else -t[1])
+        return out[:how_many]
 
     def _host_top_n(self, scores: np.ndarray, mask: np.ndarray,
                     how_many: int, exclude: set[str],

@@ -849,3 +849,62 @@ def test_streaming_small_drain_matches_oracle():
     finally:
         (sm._FLAT_SCORES_LIMIT, sm._MAX_CHUNK_ROWS,
          sm._BLOCK_KSEL, sm._PA_TILE) = old_limits
+
+
+class _BoostRescorer:
+    """Monotone-ish rescorer: halves every score; filters ids ending 7."""
+
+    def is_filtered(self, id_):
+        return id_.endswith("7")
+
+    def rescore(self, id_, score):
+        return score * 0.5
+
+
+class _OnlyRescorer:
+    def __init__(self, keep):
+        self.keep = set(keep)
+
+    def is_filtered(self, id_):
+        return id_ not in self.keep
+
+    def rescore(self, id_, score):
+        return score
+
+
+def test_rescorer_window_matches_full_scan():
+    """The device top-M window path must agree with the full host scan
+    for rescorers that keep enough of the head (the common case)."""
+    rng = np.random.default_rng(50)
+    model = ALSServingModel(features=8, implicit=True)
+    model.Y.bulk_load([f"i{j}" for j in range(3000)],
+                      rng.standard_normal((3000, 8)).astype(np.float32))
+    q = rng.standard_normal(8).astype(np.float32)
+    got = model.top_n(10, user_vector=q, rescorer=_BoostRescorer())
+    want = model._host_top_n(
+        np.asarray((model.Y.device_arrays()[0].astype(np.float32)
+                    @ np.pad(q, (0, model.Y.device_features - 8)))),
+        np.asarray(model.Y.device_arrays()[1]), 10, set(),
+        _BoostRescorer(), None, False)
+    assert [i for i, _ in got] == [i for i, _ in want]
+    for (_, a), (_, b) in zip(got, want):
+        assert abs(a - b) < 1e-4
+
+
+def test_rescorer_window_falls_back_when_filtered_out():
+    """A rescorer that keeps only items far below the top-M window must
+    still find them (fallback to the full pull — the window form never
+    changes WHICH items are reachable)."""
+    rng = np.random.default_rng(51)
+    model = ALSServingModel(features=4, implicit=True)
+    n = 3000
+    mat = rng.standard_normal((n, 4)).astype(np.float32)
+    q = rng.standard_normal(4).astype(np.float32)
+    scores = mat @ q
+    # keep exactly the three WORST-scoring ids: guaranteed outside any
+    # top-512 window
+    worst = np.argsort(scores)[:3]
+    keep = {f"i{j}" for j in worst}
+    model.Y.bulk_load([f"i{j}" for j in range(n)], mat)
+    got = model.top_n(5, user_vector=q, rescorer=_OnlyRescorer(keep))
+    assert {i for i, _ in got} == keep
