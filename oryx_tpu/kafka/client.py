@@ -1,21 +1,28 @@
-"""Optional real-Kafka-protocol binding behind the broker seam.
+"""Real-Kafka-protocol binding behind the broker seam.
 
 Reference: framework/kafka-util/src/main/java/com/cloudera/oryx/kafka/
 util/KafkaUtils.java:63-181 — topic create/exists/delete and
 per-(topic, partition) consumer-group offset get/set against a real
 broker.  The lambda layers address brokers by URI; ``memory://`` and
 ``file://`` resolve in-process (inproc.py), while a bare ``host:port``
-resolves here to a ``KafkaBroker`` speaking the real wire protocol via
-``kafka-python`` — import-guarded, because that library is optional and
-absent from the hermetic image.  The class implements the same surface
-as ``InProcBroker`` (the contract tests in tests/test_kafka.py
-parametrize over both and skip this one when no broker is reachable),
-so every layer works unchanged against a production Kafka cluster.
+resolves here to a ``KafkaBroker`` speaking the Kafka binary protocol
+directly over sockets (wire.py — stdlib-only, no client library
+required; the same hand-rolled-transport policy as the serving tier's
+HTTP/1.1 + HTTP/2 + HPACK stack).  The class implements the same
+surface as ``InProcBroker`` (the contract tests in tests/test_kafka.py
+parametrize over in-proc, the in-process MiniKafkaBroker, and — when
+``KAFKA_TEST_BOOTSTRAP`` names one — an external cluster), so every
+layer works unchanged against production Kafka.
 
-Offsets live broker-side in Kafka's ``__consumer_offsets`` (the modern
-equivalent of the reference's ZooKeeper offset store); models larger
-than the topic's max message size travel as MODEL-REF paths exactly as
-with the in-proc broker.
+Consumers use explicit partition assignment with standalone-consumer
+offset commits (generation -1): the reference's layers always consume
+whole topics with manually-managed offsets
+(AbstractSparkLayer.java:170-216, UpdateOffsetsFn.java:37-64), so group
+rebalancing machinery is deliberately out of scope.  Offsets live
+broker-side in ``__consumer_offsets`` (the modern equivalent of the
+reference's ZooKeeper offset store); models larger than the topic's
+max message size travel as MODEL-REF paths exactly as with the in-proc
+broker.
 """
 
 from __future__ import annotations
@@ -25,20 +32,20 @@ import time
 from typing import Iterator
 
 from .api import KeyMessage, TopicProducer
+from .wire import KafkaProtocolError, WireKafkaClient
 
-__all__ = ["kafka_client_available", "get_kafka_broker", "KafkaBroker"]
+__all__ = ["kafka_client_available", "get_kafka_broker", "KafkaBroker",
+           "KafkaTopicProducer"]
 
 _BROKERS: dict[str, "KafkaBroker"] = {}
 _BROKERS_LOCK = threading.Lock()
 
 
 def kafka_client_available() -> bool:
-    """True when the optional ``kafka-python`` client is importable."""
-    try:
-        import kafka  # noqa: F401
-        return True
-    except ImportError:
-        return False
+    """Always true: the wire-protocol client is part of the framework
+    (kept for the historical seam where an optional client library
+    gated the binding)."""
+    return True
 
 
 def get_kafka_broker(bootstrap: str) -> "KafkaBroker":
@@ -59,104 +66,103 @@ def _dec(b: bytes | None) -> str | None:
     return None if b is None else b.decode("utf-8")
 
 
+def murmur2(data: bytes) -> int:
+    """Kafka's default partitioner hash (the Java client's murmur2):
+    keyed sends must land on the same partition as every other client
+    producing to a shared topic, or per-key ordering breaks."""
+    length = len(data)
+    seed = 0x9747B28C
+    m = 0x5BD1E995
+    mask = 0xFFFFFFFF
+    h = (seed ^ length) & mask
+    i = 0
+    for i in range(0, length - 3, 4):
+        k = int.from_bytes(data[i:i + 4], "little")
+        k = (k * m) & mask
+        k ^= k >> 24
+        k = (k * m) & mask
+        h = (h * m) & mask
+        h ^= k
+    left = length & 3
+    if left:
+        tail = data[length - left:]
+        if left >= 3:
+            h ^= tail[2] << 16
+        if left >= 2:
+            h ^= tail[1] << 8
+        h ^= tail[0]
+        h = (h * m) & mask
+    h ^= h >> 13
+    h = (h * m) & mask
+    h ^= h >> 15
+    return h
+
+
 class KafkaBroker:
-    """InProcBroker-surface adapter over kafka-python."""
+    """InProcBroker-surface adapter over the wire-protocol client."""
 
     def __init__(self, bootstrap: str):
         self.bootstrap = bootstrap
+        self._client = WireKafkaClient(bootstrap)
         self._lock = threading.Lock()
-        self._producer = None
-        # cached clients: one metadata/drain consumer (group=None) plus
-        # one per consumer group for offset commits — a new KafkaConsumer
-        # per call would pay a TCP bootstrap + metadata fetch each time
-        self._cached: dict[str | None, object] = {}
-        self._cached_lock = threading.Lock()
+        # sticky per-topic round-robin pointer for unkeyed sends
+        self._rr: dict[str, int] = {}
+        # per-group coordinator clients: offset commits/fetches must go
+        # to the group's coordinator broker on a multi-node cluster
+        self._coord: dict[str, WireKafkaClient] = {}
+        self._coord_lock = threading.Lock()
 
-    # -- clients -------------------------------------------------------------
-
-    def _admin(self):
-        from kafka.admin import KafkaAdminClient
-        return KafkaAdminClient(bootstrap_servers=self.bootstrap)
-
-    def _consumer(self, group: str | None = None, **kw):
-        """A fresh consumer the CALLER owns and closes (needed for
-        subscribe-based streaming consumption)."""
-        from kafka import KafkaConsumer
-        return KafkaConsumer(bootstrap_servers=self.bootstrap,
-                             group_id=group, enable_auto_commit=False, **kw)
-
-    class _shared_consumer:
-        """Context manager lending the cached consumer for ``group``
-        under the cache lock (assignment state is mutable, so borrowers
-        must be serialized)."""
-
-        def __init__(self, broker: "KafkaBroker", group: str | None):
-            self._broker = broker
-            self._group = group
-
-        def __enter__(self):
-            self._broker._cached_lock.acquire()
-            c = self._broker._cached.get(self._group)
+    def _coordinator(self, group: str) -> WireKafkaClient:
+        with self._coord_lock:
+            c = self._coord.get(group)
             if c is None:
-                c = self._broker._consumer(group=self._group)
-                self._broker._cached[self._group] = c
+                host, port = self._client.find_coordinator(group)
+                if (host, port) == (self._client.host, self._client.port):
+                    c = self._client
+                else:
+                    c = WireKafkaClient(f"{host}:{port}")
+                self._coord[group] = c
             return c
 
-        def __exit__(self, *exc):
-            self._broker._cached_lock.release()
-
-    def _get_producer(self):
-        from kafka import KafkaProducer
-        with self._lock:
-            if self._producer is None:
-                self._producer = KafkaProducer(
-                    bootstrap_servers=self.bootstrap)
-            return self._producer
-
-    # -- topic admin (KafkaUtils.java:63-133) --------------------------------
+    # -- topic admin (KafkaUtils.java:63-133) ----------------------------
 
     def topic_exists(self, topic: str) -> bool:
-        admin = self._admin()
-        try:
-            return topic in admin.list_topics()
-        finally:
-            admin.close()
+        return self._client.partitions_for(topic) is not None
 
     def create_topic(self, topic: str, partitions: int = 1) -> None:
-        from kafka.admin import NewTopic
-        from kafka.errors import TopicAlreadyExistsError
-        admin = self._admin()
-        try:
-            admin.create_topics([NewTopic(name=topic,
-                                          num_partitions=partitions,
-                                          replication_factor=1)])
-        except TopicAlreadyExistsError:
-            pass
-        finally:
-            admin.close()
+        err = self._client.create_topic(topic, partitions)
+        if err not in (0, 36):  # exists is fine
+            raise KafkaProtocolError(err, f"CreateTopics({topic})")
 
     def delete_topic(self, topic: str) -> None:
-        from kafka.errors import UnknownTopicOrPartitionError
-        admin = self._admin()
-        try:
-            admin.delete_topics([topic])
-        except UnknownTopicOrPartitionError:
-            pass
-        finally:
-            admin.close()
+        err = self._client.delete_topic(topic)
+        if err not in (0, 3):   # missing is fine
+            raise KafkaProtocolError(err, f"DeleteTopics({topic})")
 
     def num_partitions(self, topic: str) -> int:
-        with self._shared_consumer(self, None) as c:
-            parts = c.partitions_for_topic(topic)
-            return len(parts) if parts else 1
+        parts = self._client.partitions_for(topic)
+        return len(parts) if parts else 1
 
-    # -- produce / consume ---------------------------------------------------
+    def _partitions(self, topic: str) -> list[int]:
+        parts = self._client.partitions_for(topic)
+        if parts is None:
+            raise ValueError(f"no partition metadata for {topic!r}")
+        return parts
+
+    # -- produce / consume ----------------------------------------------
 
     def send(self, topic: str, key: str | None, message: str) -> int:
-        fut = self._get_producer().send(topic, key=_enc(key),
-                                        value=_enc(message))
-        meta = fut.get(timeout=30)  # sync, like the model-publish path
-        return meta.offset
+        parts = self._partitions(topic)
+        if key is not None:
+            p = parts[(murmur2(key.encode("utf-8")) & 0x7FFFFFFF)
+                      % len(parts)]
+        else:
+            with self._lock:
+                i = self._rr.get(topic, 0)
+                self._rr[topic] = i + 1
+            p = parts[i % len(parts)]
+        return self._client.produce(topic, p,
+                                    [(_enc(key), _enc(message))])
 
     def latest_offset(self, topic: str) -> int:
         offs = self.latest_offsets(topic)
@@ -167,71 +173,52 @@ class KafkaBroker:
         return offs[0]
 
     def latest_offsets(self, topic: str) -> list[int]:
-        from kafka import TopicPartition
-        with self._shared_consumer(self, None) as c:
-            parts = sorted(c.partitions_for_topic(topic) or [0])
-            tps = [TopicPartition(topic, p) for p in parts]
-            end = c.end_offsets(tps)
-            return [end[tp] for tp in tps]
+        return [self._client.list_offset(topic, p, -1)
+                for p in self._partitions(topic)]
 
     def read_range(self, topic: str, start: int, end: int) -> list[KeyMessage]:
         return self.read_ranges(topic, [start], [end])
 
     def read_ranges(self, topic: str, starts: list[int | None],
                     ends: list[int]) -> list[KeyMessage]:
-        from kafka import TopicPartition
         if len(starts) != len(ends):
             raise ValueError(
                 f"read_ranges: {len(starts)} starts vs {len(ends)} ends")
         if all(e <= (0 if s is None else s)
                for s, e in zip(starts, ends)):
-            # idle tails poll every topic twice a second — don't pay a
-            # consumer bootstrap just to drain nothing
             return []
-        # Dedicated consumer: a drain can poll up to 30 s per partition,
-        # which must not hold the shared-consumer cache lock and block
-        # every other metadata/offset call in the process.
-        c = self._consumer(group=None)
+        parts = self._partitions(topic)
+        if len(parts) != len(starts):
+            raise ValueError(
+                f"read_ranges: topic {topic!r} has {len(parts)} "
+                f"partition(s) but {len(starts)} range(s) were given"
+                " — refusing a partial drain")
+        out: list[KeyMessage] = []
+        # dedicated connection: a drain long-polls per partition, which
+        # must not hold the shared connection and block every other
+        # metadata/offset/produce call in the process
+        c = WireKafkaClient(self.bootstrap)
         try:
-            parts_meta = c.partitions_for_topic(topic)
-            if parts_meta is None:
-                # zip() against a guessed [0] would silently truncate
-                # and let the caller commit ends for undrained
-                # partitions — records lost for good
-                raise ValueError(
-                    f"read_ranges: no partition metadata for {topic!r}")
-            parts = sorted(parts_meta)
-            if len(parts) != len(starts):
-                raise ValueError(
-                    f"read_ranges: topic {topic!r} has {len(parts)} "
-                    f"partition(s) but {len(starts)} range(s) were given"
-                    " — refusing a partial drain")
-            out: list[KeyMessage] = []
             for p, (s, e) in zip(parts, zip(starts, ends)):
                 s = 0 if s is None else s
-                if e <= s:
-                    continue
-                tp = TopicPartition(topic, p)
-                c.assign([tp])
-                c.seek(tp, s)
+                pos = s
                 deadline = time.monotonic() + 30
-                # completion is judged by the consumer POSITION, not a
-                # record count: compacted/transactional topics have
-                # offset gaps, so counting records would never terminate
-                while c.position(tp) < e:
+                while pos < e:
                     if time.monotonic() >= deadline:
                         # a silent partial drain would let the caller
                         # commit past unread records (permanent loss);
-                        # failing loudly keeps at-least-once intact —
-                        # the layer retries the whole range next run
+                        # fail loudly and the layer retries the whole
+                        # range next run
                         raise TimeoutError(
-                            f"drained only [{s}, {c.position(tp)}) of "
-                            f"[{s}, {e}) from {topic}/p{p} within 30s")
-                    for recs in c.poll(timeout_ms=500).values():
-                        for r in recs:
-                            if r.offset >= e:
-                                break
-                            out.append(KeyMessage(_dec(r.key), _dec(r.value)))
+                            f"drained only [{s}, {pos}) of [{s}, {e}) "
+                            f"from {topic}/p{p} within 30s")
+                    recs = c.fetch(topic, p, pos, max_wait_ms=500)
+                    for off, key, value in recs:
+                        if off >= e:
+                            break
+                        out.append(KeyMessage(_dec(key), _dec(value)))
+                    if recs:
+                        pos = max(pos + 1, recs[-1][0] + 1)
             return out
         finally:
             c.close()
@@ -241,45 +228,65 @@ class KafkaBroker:
                 poll_timeout_sec: float = 0.1,
                 stop: threading.Event | None = None,
                 max_idle_sec: float | None = None) -> Iterator[KeyMessage]:
-        from kafka import TopicPartition
-        from kafka.structs import OffsetAndMetadata
-        c = self._consumer(
-            group=group,
-            auto_offset_reset="earliest" if from_beginning else "latest")
-        c.subscribe([topic])
+        parts = self._partitions(topic)
+        # dedicated connection: a tailing consumer long-polls forever
+        # and must not serialize other callers through the shared one
+        c = WireKafkaClient(self.bootstrap)
+        positions: dict[int, int] = {}
+        committed: dict[int, int | None] = (
+            self._coordinator(group).offset_fetch(group, topic, parts)
+            if group is not None else {p: None for p in parts})
+        for p in parts:
+            if committed.get(p) is not None:
+                positions[p] = committed[p]
+            elif from_beginning:
+                positions[p] = 0
+            else:
+                positions[p] = c.list_offset(topic, p, -1)
         idle_since = time.monotonic()
-        # Offsets of records already handed back AND processed (control
-        # returned to this generator, i.e. the caller asked for the next
-        # one).  Committed in one round trip per poll batch — one
-        # blocking commit per record would throttle the update-topic
-        # tail to the broker's commit RTT.  A crash between commits
-        # re-delivers processed-but-uncommitted records: at-least-once.
-        pending: dict = {}
+        # offsets of records already handed back AND processed (control
+        # returned to this generator); committed in one round trip per
+        # poll batch — at-least-once on a crash between commits
+        pending: dict[int, int] = {}
 
         def _commit_pending() -> None:
             if group is not None and pending:
-                c.commit({tp: OffsetAndMetadata(off, None)
-                          for tp, off in pending.items()})
+                self._coordinator(group).offset_commit(
+                    group, topic, dict(pending))
                 pending.clear()
 
+        def _fetch(p: int) -> list:
+            try:
+                return c.fetch(topic, p, positions[p],
+                               max_wait_ms=wait_ms)
+            except KafkaProtocolError as e:
+                if e.code != 1:  # OFFSET_OUT_OF_RANGE
+                    raise
+                # retention truncated past our position (or the topic
+                # was recreated): reset the way auto.offset.reset does
+                # and keep the consumer alive — a dead update-topic
+                # tail would freeze the layer's model state forever
+                positions[p] = c.list_offset(
+                    topic, p, -2 if from_beginning else -1)
+                return []
+
+        wait_ms = max(1, int(poll_timeout_sec * 1000))
         try:
             while True:
                 if stop is not None and stop.is_set():
                     return
                 _commit_pending()
-                polled = c.poll(timeout_ms=int(poll_timeout_sec * 1000))
                 got = False
-                for recs in polled.values():
-                    for r in recs:
+                for p in parts:
+                    for off, key, value in _fetch(p):
                         got = True
                         idle_since = time.monotonic()
-                        yield KeyMessage(_dec(r.key), _dec(r.value))
+                        positions[p] = off + 1
+                        yield KeyMessage(_dec(key), _dec(value))
                         # reaching here means the caller consumed the
-                        # record; a bare commit() before the yield would
-                        # commit unprocessed records (at-least-once
-                        # violation)
-                        pending[TopicPartition(r.topic, r.partition)] = (
-                            r.offset + 1)
+                        # record; committing before the yield would
+                        # commit unprocessed records
+                        pending[p] = off + 1
                         if stop is not None and stop.is_set():
                             return
                 if (not got and max_idle_sec is not None
@@ -291,38 +298,27 @@ class KafkaBroker:
             finally:
                 c.close()
 
-    # -- offsets (broker-side group offsets; KafkaUtils.java:134-180) --------
+    # -- offsets (broker-side group offsets; KafkaUtils.java:134-180) ----
 
     def get_offset(self, group: str, topic: str,
                    partition: int = 0) -> int | None:
-        from kafka import TopicPartition
-        with self._shared_consumer(self, group) as c:
-            return c.committed(TopicPartition(topic, partition))
+        return self._coordinator(group).offset_fetch(
+            group, topic, [partition]).get(partition)
 
     def get_offsets(self, group: str, topic: str) -> list[int | None]:
-        from kafka import TopicPartition
-        with self._shared_consumer(self, group) as c:
-            parts = sorted(c.partitions_for_topic(topic) or [0])
-            return [c.committed(TopicPartition(topic, p)) for p in parts]
+        parts = self._partitions(topic)
+        got = self._coordinator(group).offset_fetch(group, topic, parts)
+        return [got.get(p) for p in parts]
 
     def set_offset(self, group: str, topic: str, offset: int,
                    partition: int = 0) -> None:
-        self._commit_offsets(group, topic, {partition: offset})
+        self._coordinator(group).offset_commit(group, topic,
+                                               {partition: offset})
 
     def set_offsets(self, group: str, topic: str,
                     offsets: list[int]) -> None:
-        self._commit_offsets(group, topic, dict(enumerate(offsets)))
-
-    def _commit_offsets(self, group: str, topic: str,
-                        by_partition: dict[int, int]) -> None:
-        from kafka import TopicPartition
-        from kafka.structs import OffsetAndMetadata
-        with self._shared_consumer(self, group) as c:
-            tps = {TopicPartition(topic, p): OffsetAndMetadata(off, None)
-                   for p, off in by_partition.items()}
-            c.assign(list(tps))
-            c.commit(tps)
-            c.unsubscribe()
+        self._coordinator(group).offset_commit(group, topic,
+                                               dict(enumerate(offsets)))
 
     def fill_in_latest_offsets(self, group: str, topics: list[str]) -> None:
         for topic in topics:
@@ -331,22 +327,19 @@ class KafkaBroker:
             missing = {p: end for p, (end, cur) in
                        enumerate(zip(latest, committed)) if cur is None}
             if missing:
-                self._commit_offsets(group, topic, missing)
+                self._coordinator(group).offset_commit(group, topic,
+                                                       missing)
 
     def flush(self) -> None:
-        with self._lock:
-            if self._producer is not None:
-                self._producer.flush()
+        pass  # sends are synchronous acked produces
 
     def close(self) -> None:
-        with self._lock:
-            if self._producer is not None:
-                self._producer.close()
-                self._producer = None
-        with self._cached_lock:
-            for c in self._cached.values():
-                c.close()
-            self._cached.clear()
+        self._client.close()
+        with self._coord_lock:
+            for c in self._coord.values():
+                if c is not self._client:
+                    c.close()
+            self._coord.clear()
 
 
 class KafkaTopicProducer(TopicProducer):
@@ -356,14 +349,9 @@ class KafkaTopicProducer(TopicProducer):
         self._broker_uri = broker_uri
         self._topic = topic
         self._broker = get_kafka_broker(broker_uri)
-        self._async = async_send
 
     def send(self, key: str | None, message: str) -> None:
-        if self._async:
-            self._broker._get_producer().send(
-                self._topic, key=_enc(key), value=_enc(message))
-        else:
-            self._broker.send(self._topic, key, message)
+        self._broker.send(self._topic, key, message)
 
     def get_update_broker(self) -> str:
         return self._broker_uri

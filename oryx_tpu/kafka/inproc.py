@@ -91,14 +91,10 @@ def resolve_broker(broker_uri: str) -> "InProcBroker":
         # the way the reference's layers share a real Kafka cluster
         path = os.path.abspath(broker_uri[len("file://"):])
         return get_broker(name=f"file:{path}", persist_dir=path)
-    from .client import kafka_client_available
-    if kafka_client_available():
-        from .client import get_kafka_broker
-        return get_kafka_broker(broker_uri)
-    raise RuntimeError(
-        f"Kafka-protocol broker {broker_uri!r} requested but no Kafka client "
-        "library is available in this environment; use a memory:// or "
-        "file:// broker, or install kafka-python")
+    # bare host:port = a real Kafka-protocol broker, spoken by the
+    # framework's own stdlib wire client (kafka/wire.py)
+    from .client import get_kafka_broker
+    return get_kafka_broker(broker_uri)
 
 
 class _Partition:

@@ -106,9 +106,14 @@ def test_producer_and_uri_resolution():
                                            max_idle_sec=0.1)] == ["m"]
 
 
-def test_resolve_rejects_external_broker():
-    with pytest.raises(RuntimeError, match="Kafka"):
-        resolve_broker("localhost:9092")
+def test_resolve_external_broker_binds_wire_client():
+    """A bare host:port resolves to the wire-protocol binding (lazy
+    connection — errors surface on first use, not at resolve)."""
+    from oryx_tpu.kafka.client import KafkaBroker
+    b = resolve_broker("localhost:19092")
+    assert isinstance(b, KafkaBroker)
+    with pytest.raises((ConnectionError, OSError)):
+        b.topic_exists("nope")
 
 
 def test_utils_module():
@@ -244,60 +249,40 @@ def test_stale_single_partition_writer_lands_in_p0(tmp_path):
 # -- broker contract suite, parametrized over implementations ----------------
 #
 # The same offset/replay contract must hold for the in-proc broker and
-# the optional real-Kafka binding (reference: KafkaUtils.java:63-181).
-# The kafka case skips unless kafka-python is importable AND a broker
-# answers at KAFKA_TEST_BOOTSTRAP (default localhost:9092).
+# the real-Kafka binding (reference: KafkaUtils.java:63-181).  The wire
+# leg runs the production protocol client (kafka/wire.py) against a
+# real-socket broker: an external cluster when KAFKA_TEST_BOOTSTRAP
+# names one, otherwise an in-process MiniKafkaBroker — the analog of
+# the reference's LocalKafkaBroker.java:35, so this leg ALWAYS runs.
 
-def _kafka_test_broker():
+_MINI_BROKER = None
+
+
+def _wire_test_broker():
     import os
     import socket
-    from oryx_tpu.kafka.client import (get_kafka_broker,
-                                       kafka_client_available)
-    if not kafka_client_available():
-        pytest.skip("kafka-python not installed")
-    import kafka
-    if getattr(kafka, "_ORYX_FAKE", False):
-        # the fakekafka leg may have installed the in-process fake
-        # earlier in the session; this leg is for a REAL broker only
-        pytest.skip("kafka-python not installed (in-process fake active)")
-    bootstrap = os.environ.get("KAFKA_TEST_BOOTSTRAP", "localhost:9092")
-    # first entry of a possibly multi-host bootstrap list; a malformed
-    # value skips rather than erroring the suite
-    first = bootstrap.split(",")[0]
-    host, _, port = first.partition(":")
-    try:
-        socket.create_connection((host, int(port or 9092)), 1).close()
-    except (OSError, ValueError):
-        pytest.skip(f"no Kafka broker reachable at {bootstrap}")
-    return get_kafka_broker(bootstrap)
-
-
-def _fake_kafka_broker():
-    """The real-Kafka binding (kafka/client.py) running against the
-    stateful kafka-python fake (tests/fake_kafka.py): the full client
-    code path — metadata, range drains, batched commits, group resume —
-    exercised against one consistent broker-state machine.  The real
-    library cannot be installed in this image; see fake_kafka's
-    docstring for why this is the strongest evidence available."""
-    from tests import fake_kafka
-    fake_kafka.install()
-    import kafka
-    if not getattr(kafka, "_ORYX_FAKE", False):
-        pytest.skip("real kafka-python importable; the fake-binding leg "
-                    "would bootstrap real sockets against a bogus host")
     from oryx_tpu.kafka.client import KafkaBroker
-    return KafkaBroker("fake-" + str(time.monotonic_ns()))
+
+    bootstrap = os.environ.get("KAFKA_TEST_BOOTSTRAP")
+    if bootstrap:
+        first = bootstrap.split(",")[0]
+        host, _, port = first.partition(":")
+        try:
+            socket.create_connection((host, int(port or 9092)), 1).close()
+        except (OSError, ValueError):
+            pytest.skip(f"no Kafka broker reachable at {bootstrap}")
+        return KafkaBroker(first)
+    global _MINI_BROKER
+    if _MINI_BROKER is None:
+        from oryx_tpu.kafka.mini_broker import MiniKafkaBroker
+        _MINI_BROKER = MiniKafkaBroker()
+    return KafkaBroker(_MINI_BROKER.bootstrap)
 
 
-@pytest.fixture(params=["inproc", "fakekafka", "kafka"])
+@pytest.fixture(params=["inproc", "wire"])
 def any_broker(request):
-    if request.param == "kafka":
-        # real broker: group join/rebalance takes seconds on a default
-        # broker config (group.initial.rebalance.delay.ms=3000), so the
-        # consume idle window must comfortably exceed it
-        yield _kafka_test_broker(), 10.0
-    elif request.param == "fakekafka":
-        yield _fake_kafka_broker(), 0.5
+    if request.param == "wire":
+        yield _wire_test_broker(), 1.0
     else:
         yield (InProcBroker("contract-" + str(time.monotonic_ns())), 0.2)
 
