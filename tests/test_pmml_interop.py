@@ -193,3 +193,43 @@ def test_own_kmeans_writer_round_trips_jpmml_structure():
     back = read_clusters(doc)
     assert [(c.id, list(c.center), c.count) for c in back] == \
         [(0, [1.0, -2.0, 0.5], 10), (1, [0.0, 3.25, -1.0], 20)]
+
+
+def test_reads_single_node_tree():
+    """A root that never split is a bare TreeModel whose only Node is a
+    leaf (RDFUpdate.rdfModelToPMML:381-383 skips the MiningModel
+    wrapper for one tree; toTreeModel leaf branch :463-479)."""
+    from oryx_tpu.app.rdf.pmml import read_forest, validate_pmml_vs_schema
+
+    doc = _fixture("jpmml_rdf_single_node.pmml.xml")
+    schema = _rdf_schema(["age", "color"], ["age"], ["color"], "color")
+    validate_pmml_vs_schema(doc, schema)
+    forest, encodings = read_forest(doc)
+    assert len(forest.trees) == 1
+    root = forest.trees[0].root
+    assert root.is_terminal
+    probs = root.prediction.category_probabilities
+    assert probs[encodings.get_value_encoding_map(1)["red"]] == \
+        pytest.approx(0.8)
+    assert list(forest.feature_importances) == [1.0, 0.0]
+
+
+def test_model_ref_sized_als_doc_resolves_and_parses():
+    """The MODEL-REF size class: a document bigger than the tier-3
+    max-message-size (AbstractLambdaIT.java:104 uses 1<<12) travels as
+    a path under key MODEL-REF (MLUpdate.java:224-237) and the consumer
+    opens it (AppPMMLUtils.readPMMLFromUpdateKeyMessage:259-277).
+    XIDs/YIDs exercise every joinPMMLDelimited quoting rule."""
+    from oryx_tpu.app.pmml_utils import read_pmml_from_update_key_message
+
+    path = os.path.join(FIXTURES, "jpmml_als_modelref.pmml.xml")
+    assert os.path.getsize(path) > (1 << 12)  # the MODEL-REF size class
+    doc = read_pmml_from_update_key_message("MODEL-REF", f"file://{path}")
+    assert doc is not None
+    assert pmml_io.get_extension_value(doc, "features") == "25"
+    xids = pmml_io.get_extension_content(doc, "XIDs")
+    yids = pmml_io.get_extension_content(doc, "YIDs")
+    assert len(xids) == 400 and len(yids) == 300
+    assert xids[7] == "user 7"        # space-quoted value
+    assert xids[100] == 'u"100'       # embedded-quote escape
+    assert yids[0] == "item 0" and yids[1] == "i1"
