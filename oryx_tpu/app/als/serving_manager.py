@@ -42,6 +42,10 @@ class ALSServingModelManager(AbstractServingModelManager):
         # P4/P5 scale-out: shard the item matrix over a device mesh
         # (oryx.serving.api.item-shards; 1 = single-chip scan)
         self.item_shards = config.get_int("oryx.serving.api.item-shards")
+        self.int8_selection = config.get_string(
+            "oryx.serving.api.int8-selection")
+        if self.int8_selection not in ("auto", "true", "false"):
+            raise ValueError("int8-selection must be auto/true/false")
         if self.item_shards < 1 or (self.item_shards
                                     & (self.item_shards - 1)):
             raise ValueError("item-shards must be a power of two >= 1")
@@ -91,11 +95,11 @@ class ALSServingModelManager(AbstractServingModelManager):
             if self.model is None or features != self.model.features:
                 _log.warning("No previous model, or # features changed; "
                              "creating new one")
-                self.model = ALSServingModel(features, implicit,
-                                             self.sample_rate,
-                                             self.rescorer_provider,
-                                             dtype=self.factor_dtype,
-                                             item_shards=self.item_shards)
+                self.model = ALSServingModel(
+                    features, implicit, self.sample_rate,
+                    self.rescorer_provider, dtype=self.factor_dtype,
+                    item_shards=self.item_shards,
+                    int8_selection=self.int8_selection)
             _log.info("Updating model")
             x_ids = set(pmml_io.get_extension_content(pmml, "XIDs") or [])
             y_ids = set(pmml_io.get_extension_content(pmml, "YIDs") or [])

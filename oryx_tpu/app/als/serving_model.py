@@ -463,14 +463,140 @@ def _masked_top_k(scores, mask, k: int):
     return jax.lax.top_k(masked, k)
 
 
-@jax.jit
-def _penalty_kernel(active):
-    """(N//_BLOCK_ROWS, _BLOCK_ROWS) additive mask for the pallas
-    phase-A kernel.  The lane-aligned 2D layout matters: an (N, 1)
-    input would be lane-padded x128 by TPU tiling — 9.5 GB of pure
-    padding at 20M rows (measured compile OOM)."""
+@partial(jax.jit, static_argnames=("bs",))
+def _penalty_kernel(active, bs: int):
+    """(N//bs, bs) additive mask for the pallas phase-A kernel.  The
+    lane-aligned 2D layout matters: an (N, 1) input would be
+    lane-padded x128 by TPU tiling — 9.5 GB of pure padding at 20M
+    rows (measured compile OOM).  ``bs`` is an explicit static arg so
+    jit caching keys on it — a captured module global would bake the
+    FIRST caller's value into every same-shaped later call."""
     return jnp.where(active, 0.0, -jnp.inf).astype(jnp.float32).reshape(
-        -1, _BLOCK_ROWS)
+        -1, bs)
+
+
+# retired-row penalty for the int8 selection kernel: far below any real
+# int8 dot product (|s_int| <= 127*127*F < 2^23 at F <= 512) yet far
+# from int32 overflow when added to one
+_I8_PENALTY = -(1 << 29)
+
+
+@partial(jax.jit, static_argnames=("bs",))
+def _penalty_kernel_i32(active, bs: int):
+    return jnp.where(active, 0, _I8_PENALTY).astype(jnp.int32).reshape(
+        -1, bs)
+
+
+@partial(jax.jit, static_argnames=("bs",))
+def _quantize_items_kernel(vecs, bs: int):
+    """Per-128-row-block int8 quantization of the item matrix, on
+    device: (Y8, per-block scale, per-block max row L1 norm).
+
+    The block granularity is deliberate: phase A reduces scores to
+    per-block maxima, and a SHARED scale within each block makes
+    ``max(s_int) * scale`` a sound transform of the block's quantized
+    maxima (per-row scales could not be applied after the max).  The
+    L1 norms feed the quantization-error margin that turns quantized
+    maxima into sound upper BOUNDS on exact block maxima."""
+    f32 = vecs.astype(jnp.float32)
+    blocks = f32.reshape(-1, bs, f32.shape[1])
+    scale = jnp.max(jnp.abs(blocks), axis=(1, 2)) / 127.0
+    safe = jnp.maximum(scale, 1e-30)
+    y8 = jnp.clip(jnp.round(blocks / safe[:, None, None]),
+                  -127, 127).astype(jnp.int8).reshape(f32.shape)
+    l1 = jnp.max(jnp.sum(jnp.abs(blocks), axis=2), axis=1)
+    return y8, scale, l1
+
+
+@partial(jax.jit, static_argnames=("k", "bs", "ksel", "max_bits",
+                                   "interpret"))
+def _batch_top_n_twophase_pallas_i8(Y, Y8, sy_b, l1y_b, Q, penalty_i,
+                                    active, buckets, hyperplanes,
+                                    k: int, bs: int, ksel: int,
+                                    max_bits: int,
+                                    interpret: bool = False):
+    """Two-phase streaming top-k with an INT8 phase A: block selection
+    runs on a quantized mirror of the item matrix (half the HBM bytes
+    of bf16, double MXU rate — measured 11.6 -> 5.3 ms per 256-window
+    at 20M padded-128 rows), while phase B rescores the winners from
+    the EXACT bf16/f32 factors as always.  Exactness is preserved by
+    construction: quantized block maxima are inflated by the worst-case
+    quantization error into sound upper bounds, selection/certificate
+    run on the bounds, and the existing kth >= max(unselected bound)
+    certificate catches any quantization-induced miss (falling back to
+    the exact scan).  ``penalty_i`` is the int32 retired-row mask."""
+    from jax.experimental import pallas as pl
+
+    N, F = Y8.shape
+    B = Q.shape[0]
+    T = _PA_TILE
+    # per-query symmetric quantization of the SAME operand phase B
+    # reduces (the lane-padded, possibly bf16-cast query): the error
+    # bound must cover the scores the certificate checks, and a bf16
+    # store rescores against bf16(Q), not raw f32(Q)
+    Qc = _q_cast(Q, Y)
+    Qf = Qc.astype(jnp.float32)
+    sq = jnp.maximum(jnp.max(jnp.abs(Qf), axis=1), 1e-30) / 127.0
+    q8 = jnp.clip(jnp.round(Qf / sq[:, None]), -127, 127).astype(jnp.int8)
+    target = None
+    if buckets is not None:
+        target = _query_buckets(Q, hyperplanes)
+
+    if buckets is None:
+        def kern(q_ref, y_ref, p_ref, o_ref):
+            s = jax.lax.dot_general(y_ref[...], q_ref[...],
+                                    (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.int32)
+            s3 = s.reshape(T // bs, bs, B) + p_ref[...][:, :, None]
+            o_ref[...] = s3.max(1)
+
+        ins = (q8, Y8, penalty_i)
+        in_specs = [pl.BlockSpec((B, F), lambda i: (0, 0)),
+                    pl.BlockSpec((T, F), lambda i: (i, 0)),
+                    pl.BlockSpec((T // bs, bs), lambda i: (i, 0))]
+    else:
+        def kern(q_ref, y_ref, p_ref, b_ref, t_ref, o_ref):
+            s = jax.lax.dot_general(y_ref[...], q_ref[...],
+                                    (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.int32)
+            s3 = s.reshape(T // bs, bs, B) + p_ref[...][:, :, None]
+            ok = jax.lax.population_count(
+                jnp.bitwise_xor(b_ref[...][:, :, None],
+                                t_ref[...][0][None, None, :])) <= max_bits
+            s3 = jnp.where(ok, s3, _I8_PENALTY)
+            o_ref[...] = s3.max(1)
+
+        ins = (q8, Y8, penalty_i, buckets.reshape(-1, bs),
+               target[None, :])
+        in_specs = [pl.BlockSpec((B, F), lambda i: (0, 0)),
+                    pl.BlockSpec((T, F), lambda i: (i, 0)),
+                    pl.BlockSpec((T // bs, bs), lambda i: (i, 0)),
+                    pl.BlockSpec((T // bs, bs), lambda i: (i, 0)),
+                    pl.BlockSpec((1, B), lambda i: (0, 0))]
+
+    Mt_int = pl.pallas_call(
+        kern, grid=(N // T,), in_specs=in_specs,
+        out_specs=pl.BlockSpec((T // bs, B), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((N // bs, B), jnp.int32),
+        interpret=interpret)(*ins)
+    # sound upper bound on each block's EXACT max score:
+    #   s = sy*sq*s_int + err, |err| <= sq/2*L1(y) + sy/2*L1(q) + F*sy*sq/4
+    # (y = y8*sy + ey with |ey| <= sy/2, q likewise; cross terms
+    # bounded by the L1 norms, quadratic term by F/4 scale products).
+    # Masked entries stay -inf so a fully-retired/out-of-ball block can
+    # never fail a certificate.
+    l1q = jnp.sum(jnp.abs(Qf), axis=1)                      # (B,)
+    masked = Mt_int <= _I8_PENALTY // 2
+    bound = (Mt_int.astype(jnp.float32) * sy_b[:, None] * sq[None, :]
+             + 0.5 * sq[None, :] * l1y_b[:, None]
+             + 0.5 * sy_b[:, None] * l1q[None, :]
+             + 0.25 * F * sy_b[:, None] * sq[None, :])
+    # a zero query row (window padding) scores exactly 0 everywhere on
+    # both phases; a small positive margin bound would fail its
+    # certificate on EVERY padded drain — its true bound is 0^- = -inf
+    bound = jnp.where(masked | (l1q[None, :] == 0.0), -jnp.inf, bound)
+    return _phase_b(Y, Qc, active, buckets, target, bound.T, k, bs,
+                    ksel, max_bits)
 
 
 class ALSServingModel(FactorModelBase, ServingModel):
@@ -478,7 +604,8 @@ class ALSServingModel(FactorModelBase, ServingModel):
 
     def __init__(self, features: int, implicit: bool,
                  sample_rate: float = 1.0, rescorer_provider=None,
-                 dtype="float32", item_shards: int = 1, mesh=None):
+                 dtype="float32", item_shards: int = 1, mesh=None,
+                 int8_selection: str | bool = "false"):
         """``item_shards`` > 1 row-shards the item matrix over that many
         devices (``oryx.serving.api.item-shards``) and routes the
         dot-product top-N scan through one SPMD program with an
@@ -527,6 +654,18 @@ class ALSServingModel(FactorModelBase, ServingModel):
         self._item_buckets_version: int = -1
         self._penalty: jax.Array | None = None
         self._penalty_version: int = -1
+        # int8 block-selection mirror (oryx.serving.api.int8-selection):
+        # "auto" enables it where the bf16 scan pays the 128-lane
+        # padding tax (features < 128).  Default false: the quantized
+        # phase A halves HBM bytes and doubles MXU rate (11.6 -> 5.3 ms
+        # measured), but bound bookkeeping + the doubled selection
+        # width return the gain end to end on this chip — kept as a
+        # measured, certificate-sound capability, not the default path
+        self._int8_selection = int8_selection
+        self._i8: tuple | None = None
+        self._i8_version: int = -1
+        self._penalty_i: jax.Array | None = None
+        self._penalty_i_version: int = -1
         self._bucket_lock = threading.Lock()
         # observability: exact-scan recomputes forced by a failed
         # two-phase certificate (expected ~0; see _APPROX_RECALL)
@@ -639,9 +778,32 @@ class ALSServingModel(FactorModelBase, ServingModel):
         padding at 20M rows — a measured compile OOM)."""
         with self._bucket_lock:
             if self._penalty is None or self._penalty_version != version:
-                self._penalty = _penalty_kernel(active)
+                self._penalty = _penalty_kernel(active, _BLOCK_ROWS)
                 self._penalty_version = version
             return self._penalty
+
+    def _int8_enabled(self) -> bool:
+        if self._int8_selection == "auto":
+            return self.Y.device_features != self.features
+        return bool(self._int8_selection) and self._int8_selection != "false"
+
+    def _cached_i8(self, vecs, version):
+        """(Y8, per-block scale, per-block L1) quantization mirror,
+        recomputed device-to-device when the Y snapshot version
+        changes."""
+        with self._bucket_lock:
+            if self._i8 is None or self._i8_version != version:
+                self._i8 = _quantize_items_kernel(vecs, _BLOCK_ROWS)
+                self._i8_version = version
+            return self._i8
+
+    def _cached_penalty_i(self, active, version) -> jax.Array:
+        with self._bucket_lock:
+            if self._penalty_i is None \
+                    or self._penalty_i_version != version:
+                self._penalty_i = _penalty_kernel_i32(active, _BLOCK_ROWS)
+                self._penalty_i_version = version
+            return self._penalty_i
 
     def _cached_buckets(self, vecs, version) -> jax.Array:
         """Per-item LSH bucket ids on device, recomputed only when the Y
@@ -852,27 +1014,52 @@ class ALSServingModel(FactorModelBase, ServingModel):
         shape stands or falls alone."""
         n_rows = int(vecs.shape[0])
         eligible = n_rows % _PA_TILE == 0
+        want_i8 = self._int8_enabled()
 
-        def key_of(qw):
+        def key_of(qw, i8_flag):
             return (n_rows, int(vecs.shape[1]), int(qw.shape[0]),
-                    str(vecs.dtype), buckets is not None, k, mb)
+                    str(vecs.dtype), buckets is not None, k, mb, i8_flag)
 
         def scan_handle(qw):
             return _batch_top_n_twophase_kernel(vecs, qw, active, buckets,
                                                 hp, k, chunk, bs, ksel,
                                                 mb)
 
-        penalty = None
+        penalty = penalty_i = i8 = None
         handles, attempted = [], []
         for qw in windows:
-            key = key_of(qw)
+            # fallback chain per shape: int8 pallas -> bf16 pallas ->
+            # lax.scan (a backend that cannot lower the int8 dot must
+            # not skip the still-working bf16 kernel)
+            use_i8 = (want_i8 and
+                      _PALLAS_STATE.get(key_of(qw, True)) != "broken")
+            key = key_of(qw, use_i8)
             if eligible and _PALLAS_STATE.get(key) != "broken":
-                if penalty is None:
-                    penalty = self._cached_penalty(active, version)
                 try:
-                    handles.append(_batch_top_n_twophase_pallas(
-                        vecs, qw, penalty, active, buckets, hp, k, bs,
-                        ksel, mb))
+                    if use_i8:
+                        if i8 is None:
+                            i8 = self._cached_i8(vecs, version)
+                            penalty_i = self._cached_penalty_i(active,
+                                                               version)
+                        y8, sy_b, l1y_b = i8
+                        # selection runs on margin-inflated BOUNDS, so
+                        # gather twice the blocks: the certificate
+                        # compares kth against the best unselected
+                        # bound, and the wider window buys back the
+                        # margin's false-failure rate for ~0.5 ms of
+                        # extra gather
+                        ksel_i8 = min(ksel * 2,
+                                      max(1, n_rows // bs - 1))
+                        handles.append(_batch_top_n_twophase_pallas_i8(
+                            vecs, y8, sy_b, l1y_b, qw, penalty_i,
+                            active, buckets, hp, k, bs, ksel_i8, mb))
+                    else:
+                        if penalty is None:
+                            penalty = self._cached_penalty(active,
+                                                           version)
+                        handles.append(_batch_top_n_twophase_pallas(
+                            vecs, qw, penalty, active, buckets, hp, k,
+                            bs, ksel, mb))
                     attempted.append(key)
                     continue
                 except Exception as e:  # noqa: BLE001 — classified

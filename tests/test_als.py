@@ -731,8 +731,8 @@ def test_pallas_phase_a_interpret_agrees_with_scan_kernel():
     act = np.ones(n, bool)
     act[::5] = False
     active = jnp.asarray(act)
-    penalty = sm._penalty_kernel(active)
     bs, ksel = 128, 8
+    penalty = sm._penalty_kernel(active, bs)
     chunk = 2048
     old_tile = sm._PA_TILE
     sm._PA_TILE = 2048
@@ -908,3 +908,133 @@ def test_rescorer_window_falls_back_when_filtered_out():
     model.Y.bulk_load([f"i{j}" for j in range(n)], mat)
     got = model.top_n(5, user_vector=q, rescorer=_OnlyRescorer(keep))
     assert {i for i, _ in got} == keep
+
+
+def test_int8_twophase_matches_oracle_interpret():
+    """The int8 phase-A selection (pallas interpret mode) must return
+    the same top-k as the exact flat path: quantized block maxima are
+    inflated into sound upper bounds, phase B rescores exactly, and the
+    certificate flags any miss."""
+    import jax.numpy as jnp
+    from oryx_tpu.app.als import serving_model as sm
+
+    rng = np.random.default_rng(60)
+    # ksel covers 24 of 32 blocks and k is small: the margin-inflated
+    # bounds of the 8 worst blocks sit far below the 4th-best score,
+    # so certificates pass robustly at toy scale (production uses
+    # 64 of ~156k blocks where the gap is far wider)
+    N, F, B, bs, ksel, k = 4096, 16, 8, 128, 24, 4
+    Y = jnp.asarray(rng.standard_normal((N, F)).astype(np.float32))
+    Q = jnp.asarray(rng.standard_normal((B, F)).astype(np.float32))
+    active = jnp.ones((N,), bool)
+    y8, sy_b, l1y_b = sm._quantize_items_kernel(Y, bs)
+    pen_i = sm._penalty_kernel_i32(active, bs)
+    old_tile = sm._PA_TILE
+    sm._PA_TILE = 1024
+    try:
+        ts, ti, cert = sm._batch_top_n_twophase_pallas_i8(
+            Y, y8, sy_b, l1y_b, Q, pen_i, active, None, None,
+            k=k, bs=bs, ksel=ksel, max_bits=0, interpret=True)
+    finally:
+        sm._PA_TILE = old_tile
+    want_s, want_i = sm._batch_top_n_kernel(Y, Q, active, k)
+    import numpy as _np
+    ok_rows = _np.asarray(cert)
+    # rows whose certificate passed must match the oracle exactly
+    assert ok_rows.sum() >= B // 2, ok_rows
+    _np.testing.assert_array_equal(_np.asarray(ti)[ok_rows],
+                                   _np.asarray(want_i)[ok_rows])
+    _np.testing.assert_allclose(_np.asarray(ts)[ok_rows],
+                                _np.asarray(want_s)[ok_rows], rtol=1e-5)
+
+
+def test_int8_quantizer_bounds_are_sound():
+    """Every exact block max must lie at or below the quantized bound
+    (the certificate's soundness rests on this inequality)."""
+    import jax.numpy as jnp
+    from oryx_tpu.app.als import serving_model as sm
+
+    rng = np.random.default_rng(61)
+    N, F, B, bs = 2048, 12, 16, 128
+    # adversarial-ish: heavy-tailed rows so block scales vary a lot
+    Y = (rng.standard_normal((N, F))
+         * rng.lognormal(0, 1.5, (N, 1))).astype(np.float32)
+    Q = rng.standard_normal((B, F)).astype(np.float32)
+    Yj = jnp.asarray(Y)
+    y8, sy_b, l1y_b = sm._quantize_items_kernel(Yj, bs)
+    sq = np.maximum(np.max(np.abs(Q), axis=1), 1e-30) / 127.0
+    q8 = np.clip(np.round(Q / sq[:, None]), -127, 127)
+    s_int = np.asarray(y8, np.int32) @ q8.T                  # (N, B)
+    m_int = s_int.reshape(-1, bs, B).max(1)                  # (N/bs, B)
+    l1q = np.abs(Q).sum(1)
+    sy = np.asarray(sy_b)
+    bound = (m_int * sy[:, None] * sq[None, :]
+             + 0.5 * sq[None, :] * np.asarray(l1y_b)[:, None]
+             + 0.5 * sy[:, None] * l1q[None, :]
+             + 0.25 * F * sy[:, None] * sq[None, :])
+    exact = (Y @ Q.T).reshape(-1, bs, B).max(1)
+    assert (bound >= exact - 1e-4).all(), \
+        float((exact - bound).max())
+
+
+def test_int8_selection_dispatch_path():
+    """With int8-selection forced on, the streaming dispatch routes
+    through the quantized kernel (falling back to the scan build on the
+    CPU test platform) and still matches the flat oracle."""
+    from oryx_tpu.app.als import serving_model as sm
+
+    rng = np.random.default_rng(62)
+    model = ALSServingModel(features=6, implicit=True,
+                            int8_selection="auto")
+    assert model._int8_enabled()  # features 6 < 128 -> padded -> on
+    model.Y.bulk_load([f"i{j}" for j in range(4096)],
+                      rng.standard_normal((4096, 6)).astype(np.float32))
+    q = rng.standard_normal((3, 6)).astype(np.float32)
+    old_limits = (sm._FLAT_SCORES_LIMIT, sm._MAX_CHUNK_ROWS,
+                  sm._BLOCK_KSEL, sm._PA_TILE)
+    old_state = dict(sm._PALLAS_STATE)
+    sm._PALLAS_STATE.clear()
+    sm._FLAT_SCORES_LIMIT = 1
+    sm._MAX_CHUNK_ROWS = 1024
+    sm._BLOCK_KSEL = 4
+    sm._PA_TILE = 1024
+    try:
+        got = model.top_n_batch(5, q)
+        want = [model.top_n(5, user_vector=v) for v in q]
+        for g, w in zip(got, want):
+            assert [i for i, _ in g] == [i for i, _ in w]
+    finally:
+        sm._PALLAS_STATE.clear()
+        sm._PALLAS_STATE.update(old_state)
+        (sm._FLAT_SCORES_LIMIT, sm._MAX_CHUNK_ROWS,
+         sm._BLOCK_KSEL, sm._PA_TILE) = old_limits
+    # default-constructed models keep the measured default (off)
+    assert not ALSServingModel(features=6, implicit=True)._int8_enabled()
+
+
+def test_int8_certificate_passes_on_zero_padded_rows():
+    """Window padding rows (all-zero queries) must not fail the int8
+    certificate: their exact scores are 0 everywhere, so their bound is
+    forced to -inf instead of a small positive quantization margin
+    (a false failure would recompute EVERY padded drain on the exact
+    scan)."""
+    import jax.numpy as jnp
+    from oryx_tpu.app.als import serving_model as sm
+
+    rng = np.random.default_rng(63)
+    N, F, bs, ksel, k = 2048, 16, 128, 12, 4
+    Y = jnp.asarray(rng.standard_normal((N, F)).astype(np.float32))
+    Q = np.zeros((8, F), np.float32)
+    Q[:3] = rng.standard_normal((3, F))  # 5 zero padding rows
+    active = jnp.ones((N,), bool)
+    y8, sy_b, l1y_b = sm._quantize_items_kernel(Y, bs)
+    pen_i = sm._penalty_kernel_i32(active, bs)
+    old_tile = sm._PA_TILE
+    sm._PA_TILE = 1024
+    try:
+        ts, ti, cert = sm._batch_top_n_twophase_pallas_i8(
+            Y, y8, sy_b, l1y_b, jnp.asarray(Q), pen_i, active, None,
+            None, k=k, bs=bs, ksel=ksel, max_bits=0, interpret=True)
+    finally:
+        sm._PA_TILE = old_tile
+    assert np.asarray(cert)[3:].all()  # padding rows always certify
