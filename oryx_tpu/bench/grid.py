@@ -197,7 +197,11 @@ def bench_config(features: int, items_m: int, model, user_ids,
             batcher_stats["mean_batch_all"] = round(
                 sum(sizes) / max(1, len(sizes)), 1)
             # UNLOADED latency at the reference's 1-3 concurrency (the
-            # baseline's p-lat regime): idle server, per worker count
+            # baseline's p-lat regime): idle server, per worker count.
+            # The tunnel floor is re-measured HERE, contemporaneously:
+            # the run-start floor can drift +-30 ms over a 50-minute
+            # grid, which dominated the p50-minus-floor column.
+            cell_floor = measure_tunnel_floor()
             unloaded = {}
             for w in (1, 2, 3):
                 lw = run_recommend_load(base, user_ids,
@@ -243,8 +247,9 @@ def bench_config(features: int, items_m: int, model, user_ids,
             "baseline_qps": base_qps,
             "baseline_p_lat_ms": base_lat,
             "vs_baseline_qps": round(sat.qps / base_qps, 2),
+            "tunnel_floor_at_cell_ms": round(cell_floor, 1),
             "p50_minus_tunnel_floor_ms": round(
-                low["p50_ms"] - tunnel_floor_ms, 1),
+                low["p50_ms"] - cell_floor, 1),
             "device_mb": round(device_bytes(model) / 1e6, 1),
             "batcher": batcher_stats,
             # exact-scan recomputes forced by failed two-phase

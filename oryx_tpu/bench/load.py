@@ -318,9 +318,24 @@ def run_recommend_open_loop(base_url: str, user_ids: list[str],
         t.start()
     for t in threads:
         t.join()
-    elapsed = time.perf_counter() - t0
     lat = np.asarray(latencies)
-    achieved = len(latencies) / elapsed if elapsed else 0.0
+    # achieved over the SCHEDULED span: dividing by wall time would
+    # fold the final requests' drain tail into the denominator and
+    # under-report by latency/duration even at a perfectly sustained
+    # rate (measured: a ~14% structural bias at 0.3 s latencies)
+    span = float(arrivals[-1])
+    achieved = len(latencies) / span if span else 0.0
+    late = np.asarray(lateness)
+    # saturation = the backlog GROWS across the run: compare mean
+    # scheduled-lateness of the third quarter vs the final quarter of
+    # arrivals; steady lateness (client pool + transport slack) is
+    # fine, divergence is not
+    n_l = len(late)
+    growing = False
+    if n_l >= 8:
+        q3 = float(np.mean(late[n_l // 2:3 * n_l // 4]))
+        q4 = float(np.mean(late[3 * n_l // 4:]))
+        growing = q4 > q3 + 200.0  # ms of drift across ~1/4 of the run
     return {
         "offered_qps": round(rate_qps, 1),
         "achieved_qps": round(achieved, 1),
@@ -329,7 +344,9 @@ def run_recommend_open_loop(base_url: str, user_ids: list[str],
         "p95_ms": round(float(np.percentile(lat, 95)), 1) if len(lat) else None,
         # mean time requests spent waiting for a free client slot past
         # their scheduled arrival — the open-loop backlog signal
-        "mean_sched_lateness_ms": round(float(np.mean(lateness)), 1)
-        if lateness else None,
-        "sustained": achieved >= 0.95 * rate_qps and errors[0] == 0,
+        "mean_sched_lateness_ms": round(float(np.mean(late)), 1)
+        if n_l else None,
+        "lateness_drift_ms": round(q4 - q3, 1) if n_l >= 8 else None,
+        "sustained": errors[0] == 0 and not growing
+        and len(latencies) + errors[0] == n,
     }
