@@ -171,14 +171,17 @@ def _batch_top_n_lsh_kernel(Y, Q, active, buckets, hyperplanes,
 
 def _stream_plan(n_rows: int, b_pad: int) -> tuple[bool, int]:
     """(use_streaming_path, chunk_rows) for a batch of ``b_pad`` queries
-    over ``n_rows`` items.  Stream whenever the item matrix is big:
-    above ~2M rows every drain size shares ONE compiled scan (the fixed
-    _CHUNKED_BATCH shape) instead of compiling the 10-GB-matmul per
+    over ``n_rows`` items.  Stream whenever the item matrix is big —
+    the flat path's lax.top_k over a (B, N) score tensor lowers to a
+    per-row sort whose cost dwarfs the matmul (measured 18 ms vs ~1 ms
+    of two-phase for a 256-window at 1M x 50f), and above ~0.5M rows
+    every drain size also shares ONE compiled scan (the fixed
+    _CHUNKED_BATCH shape) instead of compiling a multi-GB matmul per
     pow2 batch bucket."""
     chunk = _MAX_CHUNK_ROWS
     while chunk > 1024 and _CHUNKED_BATCH * chunk * 4 > _FLAT_SCORES_LIMIT:
         chunk //= 2
-    big = (n_rows > (1 << 21)
+    big = (n_rows > (1 << 19)
            or b_pad * n_rows * 4 > _FLAT_SCORES_LIMIT)
     return big, chunk
 
@@ -479,6 +482,16 @@ def _penalty_kernel(active, bs: int):
 # int8 dot product (|s_int| <= 127*127*F < 2^23 at F <= 512) yet far
 # from int32 overflow when added to one
 _I8_PENALTY = -(1 << 29)
+
+
+def _i8_ksel(ksel: int, n_rows: int, bs: int) -> int:
+    """Block-selection width for the int8 phase A: selection runs on
+    margin-inflated BOUNDS, so gather twice the blocks — the
+    certificate compares kth against the best unselected bound, and
+    the wider window buys back the margin's false-failure rate for
+    ~0.5 ms of extra gather.  Shared by the serving dispatch and the
+    kernel probe so published numbers time what serving runs."""
+    return min(ksel * 2, max(1, n_rows // bs - 1))
 
 
 @partial(jax.jit, static_argnames=("bs",))
@@ -1042,14 +1055,7 @@ class ALSServingModel(FactorModelBase, ServingModel):
                             penalty_i = self._cached_penalty_i(active,
                                                                version)
                         y8, sy_b, l1y_b = i8
-                        # selection runs on margin-inflated BOUNDS, so
-                        # gather twice the blocks: the certificate
-                        # compares kth against the best unselected
-                        # bound, and the wider window buys back the
-                        # margin's false-failure rate for ~0.5 ms of
-                        # extra gather
-                        ksel_i8 = min(ksel * 2,
-                                      max(1, n_rows // bs - 1))
+                        ksel_i8 = _i8_ksel(ksel, n_rows, bs)
                         handles.append(_batch_top_n_twophase_pallas_i8(
                             vecs, y8, sy_b, l1y_b, qw, penalty_i,
                             active, buckets, hp, k, bs, ksel_i8, mb))
