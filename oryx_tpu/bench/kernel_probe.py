@@ -29,35 +29,47 @@ import numpy as np
 __all__ = ["probe_model", "time_exec"]
 
 
-def time_exec(dispatch, fetch, m: int = 6, reps: int = 3) -> dict:
+def time_exec(dispatch, fetch, m: int = 6, reps: int = 3,
+              min_delta_ms: float = 30.0, max_m: int = 96) -> dict:
     """Median (rtt+exec) of one dispatch+fetch, and per-exec time from
     an ``m``-deep dispatch queue.  ``dispatch()`` must enqueue one
     device program and return its output handle(s) without blocking;
-    ``fetch(h)`` must block until that handle's program completed."""
+    ``fetch(h)`` must block until that handle's program completed.
+
+    Small kernels (exec ≪ tunnel-RTT jitter) would make the m-queue
+    delta indistinguishable from noise — and occasionally negative — so
+    the queue is deepened until the delta clears ``min_delta_ms``."""
     fetch(dispatch())  # ensure compiled
-    t1s, tms = [], []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        fetch(dispatch())
-        t1s.append(time.perf_counter() - t0)
-        t0 = time.perf_counter()
-        hs = [dispatch() for _ in range(m)]
-        fetch(hs[-1])
-        tms.append(time.perf_counter() - t0)
-    t1 = float(np.median(t1s))
-    tm = float(np.median(tms))
+    while True:
+        t1s, tms = [], []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fetch(dispatch())
+            t1s.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            hs = [dispatch() for _ in range(m)]
+            fetch(hs[-1])
+            tms.append(time.perf_counter() - t0)
+        t1 = float(np.median(t1s))
+        tm = float(np.median(tms))
+        if (tm - t1) * 1e3 >= min_delta_ms or m >= max_m:
+            break
+        m = min(max_m, m * 4)
     return {
         "t1_ms": round(t1 * 1e3, 1),
         "tm_ms": round(tm * 1e3, 1),
         "m": m,
-        "exec_ms": round((tm - t1) / (m - 1) * 1e3, 2),
+        "exec_ms": round((tm - t1) / (m - 1) * 1e3, 3),
     }
 
 
 def probe_model(model, batch: int = 256, how_many: int = 10,
-                m: int = 6) -> dict:
+                m: int = 6, probe_int8: bool = False) -> dict:
     """Time the exact device programs the serving path dispatches for a
-    ``batch``-query drain on ``model``, excluding host and tunnel."""
+    ``batch``-query drain on ``model``, excluding host and tunnel.
+    ``probe_int8`` additionally times the int8 block-selection phase A
+    (regardless of the model's int8-selection setting) and records its
+    certificate-failure count."""
     import jax
     import jax.numpy as jnp
 
@@ -83,7 +95,7 @@ def probe_model(model, batch: int = 256, how_many: int = 10,
         "scan_mb": round(scan_bytes / 1e6, 1),
     }
 
-    def add(name, timing):
+    def add(name, timing, bytes_scanned=None):
         if timing["exec_ms"] <= 0:
             # tunnel jitter swallowed the m-queue delta (small kernels:
             # m*exec inside the ~100 ms RTT variance) — flag rather
@@ -93,7 +105,8 @@ def probe_model(model, batch: int = 256, how_many: int = 10,
             timing["qps_ceiling"] = None
         else:
             timing["effective_gb_per_s"] = round(
-                scan_bytes / timing["exec_ms"] / 1e6, 1)
+                (bytes_scanned or scan_bytes) / timing["exec_ms"] / 1e6,
+                1)
             timing["qps_ceiling"] = round(
                 batch / timing["exec_ms"] * 1e3, 1)
         out[name] = timing
@@ -116,6 +129,31 @@ def probe_model(model, batch: int = 256, how_many: int = 10,
                         jax.device_get, m=m))
                 except Exception as e:  # noqa: BLE001 — backend-dependent
                     out["twophase_pallas_error"] = str(e)[:160]
+                if probe_int8:
+                    try:
+                        y8, sy_b, l1y_b = model._cached_i8(vecs, version)
+                        penalty_i = model._cached_penalty_i(active,
+                                                            version)
+                        ksel_i8 = sm._i8_ksel(ksel, n_rows, bs)
+                        t = time_exec(
+                            lambda: sm._batch_top_n_twophase_pallas_i8(
+                                vecs, y8, sy_b, l1y_b, Q, penalty_i,
+                                active, buckets, hp, k, bs, ksel_i8, mb),
+                            jax.device_get, m=m)
+                        # certificate pass rate at this ksel matters as
+                        # much as speed: every failed row recomputes on
+                        # the exact scan
+                        _, _, cert = jax.device_get(
+                            sm._batch_top_n_twophase_pallas_i8(
+                                vecs, y8, sy_b, l1y_b, Q, penalty_i,
+                                active, buckets, hp, k, bs, ksel_i8, mb))
+                        t["cert_fail_rows"] = int((~cert).sum())
+                        # int8 phase A scans the 1 B/elem Y8 mirror,
+                        # not the bf16/f32 store
+                        add("twophase_pallas_i8", t,
+                            bytes_scanned=n_rows * model.features)
+                    except Exception as e:  # noqa: BLE001
+                        out["twophase_pallas_i8_error"] = str(e)[:160]
         add("chunked_exact", time_exec(
             lambda: sm._batch_top_n_chunked_kernel(
                 vecs, Q, active, buckets, hp, k, chunk, mb),
@@ -142,6 +180,8 @@ def main() -> None:
     ap.add_argument("--lsh", choices=["off", "on", "both"],
                     default="off")
     ap.add_argument("--m", type=int, default=6)
+    ap.add_argument("--int8", action="store_true",
+                    help="also probe the int8 block-selection phase A")
     args = ap.parse_args()
 
     from .grid import build_model
@@ -151,11 +191,13 @@ def main() -> None:
     lsh_obj = model.lsh
     if args.lsh in ("off", "both"):
         model.lsh = None
-        print(json.dumps(probe_model(model, batch=args.batch, m=args.m)),
+        print(json.dumps(probe_model(model, batch=args.batch, m=args.m,
+                                     probe_int8=args.int8)),
               flush=True)
     if args.lsh in ("on", "both"):
         model.lsh = lsh_obj
-        print(json.dumps(probe_model(model, batch=args.batch, m=args.m)),
+        print(json.dumps(probe_model(model, batch=args.batch, m=args.m,
+                                     probe_int8=args.int8)),
               flush=True)
 
 

@@ -89,7 +89,7 @@ def test_grid_bench_toy_scale(monkeypatch):
     rng = np.random.default_rng(0)
     model, user_ids = grid.build_model(4, 600, rng)
     assert str(model.Y.device_arrays()[0].dtype) == "bfloat16"
-    rows = grid.bench_config(4, 0, model, user_ids, tunnel_floor_ms=0.0)
+    rows = grid.bench_config(4, 0, model, user_ids)
     assert len(rows) == 2
     for r in rows:
         assert r["qps"] > 0 and r["qps_errors"] == 0
