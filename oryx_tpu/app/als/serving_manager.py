@@ -46,6 +46,9 @@ class ALSServingModelManager(AbstractServingModelManager):
             "oryx.serving.api.int8-selection")
         if self.int8_selection not in ("auto", "true", "false"):
             raise ValueError("int8-selection must be auto/true/false")
+        self.fold_scan = config.get_string("oryx.serving.api.fold-scan")
+        if self.fold_scan not in ("auto", "true", "false"):
+            raise ValueError("fold-scan must be auto/true/false")
         if self.item_shards < 1 or (self.item_shards
                                     & (self.item_shards - 1)):
             raise ValueError("item-shards must be a power of two >= 1")
@@ -99,7 +102,8 @@ class ALSServingModelManager(AbstractServingModelManager):
                     features, implicit, self.sample_rate,
                     self.rescorer_provider, dtype=self.factor_dtype,
                     item_shards=self.item_shards,
-                    int8_selection=self.int8_selection)
+                    int8_selection=self.int8_selection,
+                    fold_scan=self.fold_scan)
             _log.info("Updating model")
             x_ids = set(pmml_io.get_extension_content(pmml, "XIDs") or [])
             y_ids = set(pmml_io.get_extension_content(pmml, "YIDs") or [])

@@ -372,6 +372,147 @@ def _batch_top_n_twophase_pallas(Y, Q, penalty, active, buckets,
                     max_bits)
 
 
+def _fold_factor(width: int, features: int) -> int:
+    """Rows-per-physical-row folding for the phase-A scan.  The device
+    snapshot zero-pads features below 128 to the TPU's lane width, so
+    an F=50 scan streams 2.56x its useful bytes from HBM; folding 2 (or
+    4) logical rows into one 128-lane physical row of a mirror array
+    restores the reference's time ∝ items x features proportionality
+    (docs/docs/performance.html) that the padding broke.  Returns the
+    largest fold in {4, 2} whose per-slot lane width still holds a full
+    feature vector, else 1."""
+    for fold in (4, 2):
+        w = width // fold
+        if width % fold == 0 and w >= features and w % 8 == 0:
+            return fold
+    return 1
+
+
+def _fold_eligible(width: int, features: int, bs: int) -> int:
+    """Fold factor the serving dispatch will actually use for this
+    shape (1 = no folding): _fold_factor gated by the block/tile
+    divisibility the kernel's reshape layout requires.  Shared by the
+    dispatch and the kernel probe so published numbers time what
+    serving runs."""
+    fold = _fold_factor(width, features)
+    if fold > 1 and bs % fold == 0 and _PA_TILE % fold == 0:
+        return fold
+    return 1
+
+
+@partial(jax.jit, static_argnames=("fold", "bs"))
+def _fold_items_kernel(vecs, active, fold: int, bs: int):
+    """Build the folded phase-A mirror on device: logical row
+    ``i*fold + j`` occupies lanes ``[j*w, j*w + w)`` of folded row
+    ``i`` (w = width // fold), so folded rows ``[b*bs//fold,
+    (b+1)*bs//fold)`` across all ``fold`` slots are exactly logical
+    block ``b`` — block maxima land in the same (N//bs, B) layout the
+    unfolded kernel produces.  Returns (Yf, penalty_fold) with the
+    per-slot penalty in the (fold, N//bs, bs//fold) layout the
+    kernel's block specs expect; the LSH bucket side input is folded
+    separately (_fold_buckets_kernel) so LSH/non-LSH drains share this
+    mirror."""
+    N, W = vecs.shape
+    w = W // fold
+    bsf = bs // fold
+    Yf = vecs[:, :w].reshape(N // fold, W)
+    pen = jnp.where(active, 0.0, -jnp.inf).astype(jnp.float32)
+    pen_f = pen.reshape(-1, fold).T.reshape(fold, -1, bsf)
+    return Yf, pen_f
+
+
+@partial(jax.jit, static_argnames=("fold", "bs"))
+def _fold_buckets_kernel(buckets, fold: int, bs: int):
+    """Per-slot LSH bucket ids in the fold kernel's side-input
+    layout."""
+    return buckets.reshape(-1, fold).T.reshape(fold, -1, bs // fold)
+
+
+@partial(jax.jit, static_argnames=("k", "bs", "ksel", "max_bits", "fold",
+                                   "interpret"))
+def _batch_top_n_twophase_pallas_fold(Y, Yf, Q, pen_f, active, bkt_f,
+                                      buckets, hyperplanes, k: int,
+                                      bs: int, ksel: int, max_bits: int,
+                                      fold: int,
+                                      interpret: bool = False):
+    """Two-phase streaming top-k whose phase A scans the FOLDED mirror:
+    one dot per fold slot against a slot-shifted query copy, per-block
+    reduce, max across slots.  Phase B and the exactness certificate
+    run on the canonical store arrays as always (the folded dot
+    accumulates the same bf16 products in a different MXU tree order —
+    exactly the cross-kernel divergence the certificate's relative
+    margin already covers)."""
+    from jax.experimental import pallas as pl
+
+    Nf, W = Yf.shape
+    N = Nf * fold
+    B = Q.shape[0]
+    w = W // fold
+    bsf = bs // fold
+    Tf = _PA_TILE // fold
+    Qc = _q_cast(Q, Y)
+    # slot-shifted query copies: slot j's features live in lanes
+    # [j*w, j*w + w), zeros elsewhere — the zero lanes kill the other
+    # slots' features in the shared dot
+    qw = Qc[:, :w]
+    Qs = jnp.stack([jnp.pad(qw, ((0, 0), (j * w, W - (j + 1) * w)))
+                    for j in range(fold)])
+    target = None
+    if buckets is not None:
+        target = _query_buckets(Q, hyperplanes)
+
+    if bkt_f is None:
+        def kern(q_ref, y_ref, p_ref, o_ref):
+            m = None
+            for j in range(fold):
+                s = jax.lax.dot_general(y_ref[...], q_ref[j],
+                                        (((1,), (1,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+                s3 = s.reshape(Tf // bsf, bsf, B) + p_ref[j][:, :, None]
+                mj = s3.max(1)
+                m = mj if m is None else jnp.maximum(m, mj)
+            o_ref[...] = m
+
+        ins = (Qs, Yf, pen_f)
+        in_specs = [pl.BlockSpec((fold, B, W), lambda i: (0, 0, 0)),
+                    pl.BlockSpec((Tf, W), lambda i: (i, 0)),
+                    pl.BlockSpec((fold, Tf // bsf, bsf),
+                                 lambda i: (0, i, 0))]
+    else:
+        def kern(q_ref, y_ref, p_ref, b_ref, t_ref, o_ref):
+            m = None
+            for j in range(fold):
+                s = jax.lax.dot_general(y_ref[...], q_ref[j],
+                                        (((1,), (1,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+                s3 = s.reshape(Tf // bsf, bsf, B) + p_ref[j][:, :, None]
+                ok = jax.lax.population_count(
+                    jnp.bitwise_xor(b_ref[j][:, :, None],
+                                    t_ref[...][0][None, None, :])) \
+                    <= max_bits
+                s3 = jnp.where(ok, s3, -jnp.inf)
+                mj = s3.max(1)
+                m = mj if m is None else jnp.maximum(m, mj)
+            o_ref[...] = m
+
+        ins = (Qs, Yf, pen_f, bkt_f, target[None, :])
+        in_specs = [pl.BlockSpec((fold, B, W), lambda i: (0, 0, 0)),
+                    pl.BlockSpec((Tf, W), lambda i: (i, 0)),
+                    pl.BlockSpec((fold, Tf // bsf, bsf),
+                                 lambda i: (0, i, 0)),
+                    pl.BlockSpec((fold, Tf // bsf, bsf),
+                                 lambda i: (0, i, 0)),
+                    pl.BlockSpec((1, B), lambda i: (0, 0))]
+
+    Mt = pl.pallas_call(
+        kern, grid=(N // _PA_TILE,), in_specs=in_specs,
+        out_specs=pl.BlockSpec((Tf // bsf, B), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((N // bs, B), jnp.float32),
+        interpret=interpret)(*ins)
+    return _phase_b(Y, Qc, active, buckets, target, Mt.T, k, bs, ksel,
+                    max_bits)
+
+
 @partial(jax.jit, static_argnames=("k", "chunk", "bs", "ksel", "max_bits"))
 def _batch_top_n_twophase_kernel(Y, Q, active, buckets, hyperplanes,
                                  k: int, chunk: int, bs: int, ksel: int,
@@ -618,7 +759,8 @@ class ALSServingModel(FactorModelBase, ServingModel):
     def __init__(self, features: int, implicit: bool,
                  sample_rate: float = 1.0, rescorer_provider=None,
                  dtype="float32", item_shards: int = 1, mesh=None,
-                 int8_selection: str | bool = "false"):
+                 int8_selection: str | bool = "false",
+                 fold_scan: str | bool = "auto"):
         """``item_shards`` > 1 row-shards the item matrix over that many
         devices (``oryx.serving.api.item-shards``) and routes the
         dot-product top-N scan through one SPMD program with an
@@ -677,6 +819,15 @@ class ALSServingModel(FactorModelBase, ServingModel):
         self._int8_selection = int8_selection
         self._i8: tuple | None = None
         self._i8_version: int = -1
+        # folded phase-A mirror (oryx.serving.api.fold-scan): at
+        # features <= 64 the lane-padded scan reads 2-4x its useful
+        # bytes; the fold mirror restores time ∝ items x features.
+        # "auto" (default) folds whenever the shape allows; the mirror
+        # costs 1/fold of the canonical snapshot's HBM
+        self._fold_scan = fold_scan
+        self._fold: tuple | None = None
+        self._fold_bkt: jax.Array | None = None
+        self._fold_version: int = -1
         self._penalty_i: jax.Array | None = None
         self._penalty_i_version: int = -1
         self._bucket_lock = threading.Lock()
@@ -799,6 +950,30 @@ class ALSServingModel(FactorModelBase, ServingModel):
         if self._int8_selection == "auto":
             return self.Y.device_features != self.features
         return bool(self._int8_selection) and self._int8_selection != "false"
+
+    def _fold_enabled(self) -> bool:
+        return bool(self._fold_scan) and self._fold_scan != "false"
+
+    def _cached_fold(self, vecs, active, buckets, version, fold: int,
+                     bs: int) -> tuple:
+        """(Yf, penalty_fold, buckets_fold|None) phase-A fold mirror,
+        recomputed device-to-device when the Y snapshot version
+        changes.  The mirror is shared between LSH and non-LSH drains
+        (mixed traffic must not thrash a full-matrix rebuild); the
+        bucket side input folds lazily on first LSH use per version."""
+        with self._bucket_lock:
+            if self._fold is None or self._fold_version != version:
+                self._fold = _fold_items_kernel(vecs, active, fold, bs)
+                self._fold_bkt = None
+                self._fold_version = version
+            yf, pen_f = self._fold
+            bkt_f = None
+            if buckets is not None:
+                if self._fold_bkt is None:
+                    self._fold_bkt = _fold_buckets_kernel(buckets, fold,
+                                                          bs)
+                bkt_f = self._fold_bkt
+            return yf, pen_f, bkt_f
 
     def _cached_i8(self, vecs, version):
         """(Y8, per-block scale, per-block L1) quantization mirror,
@@ -1028,28 +1203,48 @@ class ALSServingModel(FactorModelBase, ServingModel):
         n_rows = int(vecs.shape[0])
         eligible = n_rows % _PA_TILE == 0
         want_i8 = self._int8_enabled()
+        fold = _fold_eligible(int(vecs.shape[1]), self.features, bs) \
+            if self._fold_enabled() else 1
 
-        def key_of(qw, i8_flag):
+        def key_of(qw, kind):
             return (n_rows, int(vecs.shape[1]), int(qw.shape[0]),
-                    str(vecs.dtype), buckets is not None, k, mb, i8_flag)
+                    str(vecs.dtype), buckets is not None, k, mb, kind)
 
         def scan_handle(qw):
             return _batch_top_n_twophase_kernel(vecs, qw, active, buckets,
                                                 hp, k, chunk, bs, ksel,
                                                 mb)
 
-        penalty = penalty_i = i8 = None
+        penalty = penalty_i = i8 = fold_data = None
         handles, attempted = [], []
         for qw in windows:
-            # fallback chain per shape: int8 pallas -> bf16 pallas ->
-            # lax.scan (a backend that cannot lower the int8 dot must
-            # not skip the still-working bf16 kernel)
-            use_i8 = (want_i8 and
-                      _PALLAS_STATE.get(key_of(qw, True)) != "broken")
-            key = key_of(qw, use_i8)
-            if eligible and _PALLAS_STATE.get(key) != "broken":
+            # fallback chain per shape: folded pallas -> int8 pallas ->
+            # bf16/f32 pallas -> lax.scan (a backend that cannot lower
+            # one build must not skip the still-working next one)
+            kinds = []
+            if eligible:
+                if fold > 1:
+                    kinds.append("fold")
+                if want_i8:
+                    kinds.append("i8")
+                kinds.append("pallas")
+            dispatched = False
+            for kind in kinds:
+                key = key_of(qw, kind)
+                if _PALLAS_STATE.get(key) == "broken":
+                    continue
                 try:
-                    if use_i8:
+                    if kind == "fold":
+                        if fold_data is None:
+                            fold_data = self._cached_fold(
+                                vecs, active, buckets, version, fold,
+                                bs)
+                        yf, pen_f, bkt_f = fold_data
+                        handles.append(
+                            _batch_top_n_twophase_pallas_fold(
+                                vecs, yf, qw, pen_f, active, bkt_f,
+                                buckets, hp, k, bs, ksel, mb, fold))
+                    elif kind == "i8":
                         if i8 is None:
                             i8 = self._cached_i8(vecs, version)
                             penalty_i = self._cached_penalty_i(active,
@@ -1067,13 +1262,15 @@ class ALSServingModel(FactorModelBase, ServingModel):
                             vecs, qw, penalty, active, buckets, hp, k,
                             bs, ksel, mb))
                     attempted.append(key)
-                    continue
+                    dispatched = True
+                    break
                 except Exception as e:  # noqa: BLE001 — classified
                     # compile/lowering failures surface here, at
                     # dispatch, attributed to exactly this shape; a
                     # shape that worked before re-raises
                     _classify_pallas_failure([key], e)
-            handles.append(scan_handle(qw))
+            if not dispatched:
+                handles.append(scan_handle(qw))
         try:
             out = jax.device_get(handles)  # ONE fetch for the drain
         except Exception as e:  # noqa: BLE001 — classified below

@@ -247,8 +247,9 @@ def bench_config(features: int, items_m: int, model, user_ids,
             batcher.close()
         base_qps, base_lat = BASELINES[(features, items_m, lsh_on)]
         kernel_path = next((p for p in
-                            ("twophase_pallas", "twophase", "flat_lsh",
-                             "flat", "chunked_exact") if p in probe), None)
+                            ("twophase_pallas_fold", "twophase_pallas",
+                             "twophase", "flat_lsh", "flat",
+                             "chunked_exact") if p in probe), None)
         kern = probe.get(kernel_path, {})
         rows.append({
             "features": features,

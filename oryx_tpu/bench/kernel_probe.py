@@ -129,6 +129,23 @@ def probe_model(model, batch: int = 256, how_many: int = 10,
                         jax.device_get, m=m))
                 except Exception as e:  # noqa: BLE001 — backend-dependent
                     out["twophase_pallas_error"] = str(e)[:160]
+                fold = sm._fold_eligible(int(vecs.shape[1]),
+                                         model.features, bs) \
+                    if model._fold_enabled() else 1
+                if fold > 1:
+                    try:
+                        yf, pen_f, bkt_f = model._cached_fold(
+                            vecs, active, buckets, version, fold, bs)
+                        add("twophase_pallas_fold", time_exec(
+                            lambda: sm._batch_top_n_twophase_pallas_fold(
+                                vecs, yf, Q, pen_f, active, bkt_f,
+                                buckets, hp, k, bs, ksel, mb, fold),
+                            jax.device_get, m=m),
+                            # phase A streams the folded mirror
+                            bytes_scanned=scan_bytes
+                            * vecs.shape[1] // model.features // fold)
+                    except Exception as e:  # noqa: BLE001
+                        out["twophase_pallas_fold_error"] = str(e)[:160]
                 if probe_int8:
                     try:
                         y8, sy_b, l1y_b = model._cached_i8(vecs, version)
@@ -148,10 +165,10 @@ def probe_model(model, batch: int = 256, how_many: int = 10,
                                 vecs, y8, sy_b, l1y_b, Q, penalty_i,
                                 active, buckets, hp, k, bs, ksel_i8, mb))
                         t["cert_fail_rows"] = int((~cert).sum())
-                        # int8 phase A scans the 1 B/elem Y8 mirror,
-                        # not the bf16/f32 store
+                        # int8 phase A streams the 1 B/elem Y8 mirror,
+                        # which is lane-padded like the store
                         add("twophase_pallas_i8", t,
-                            bytes_scanned=n_rows * model.features)
+                            bytes_scanned=n_rows * int(vecs.shape[1]))
                     except Exception as e:  # noqa: BLE001
                         out["twophase_pallas_i8_error"] = str(e)[:160]
         add("chunked_exact", time_exec(

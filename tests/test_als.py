@@ -1038,3 +1038,81 @@ def test_int8_certificate_passes_on_zero_padded_rows():
     finally:
         sm._PA_TILE = old_tile
     assert np.asarray(cert)[3:].all()  # padding rows always certify
+
+def test_fold_mirror_layout_matches_numpy():
+    """_fold_items_kernel's slot layout: logical row i*fold + j lives
+    in lanes [j*w, j*w + w) of folded row i; penalty/bucket side inputs
+    land in the (fold, N//bs, bs//fold) layout the kernel reads."""
+    import jax
+    import jax.numpy as jnp
+    from oryx_tpu.app.als import serving_model as sm
+
+    rng = np.random.default_rng(70)
+    N, F, W, fold, bs = 1024, 20, 128, 4, 128
+    w = W // fold
+    Y = np.zeros((N, W), np.float32)
+    Y[:, :F] = rng.standard_normal((N, F)).astype(np.float32)
+    act = rng.random(N) > 0.2
+    bkt = rng.integers(0, 16, N).astype(np.int32)
+    yf, pen_f = jax.device_get(sm._fold_items_kernel(
+        jnp.asarray(Y), jnp.asarray(act), fold, bs))
+    bkt_f = jax.device_get(sm._fold_buckets_kernel(
+        jnp.asarray(bkt), fold, bs))
+    assert yf.shape == (N // fold, W)
+    for i in range(0, N // fold, 37):
+        for j in range(fold):
+            np.testing.assert_array_equal(yf[i, j * w:j * w + w],
+                                          Y[i * fold + j, :w])
+    pen = np.where(act, 0.0, -np.inf).astype(np.float32)
+    assert pen_f.shape == (fold, N // bs, bs // fold)
+    assert bkt_f.shape == (fold, N // bs, bs // fold)
+    for j in range(fold):
+        np.testing.assert_array_equal(
+            pen_f[j].reshape(-1), pen.reshape(-1, fold)[:, j])
+        np.testing.assert_array_equal(
+            bkt_f[j].reshape(-1), bkt.reshape(-1, fold)[:, j])
+
+
+def test_fold_pallas_interpret_agrees_with_scan_kernel():
+    """The folded phase-A program (pallas interpret mode) must produce
+    the same top-k and certificates as the lax.scan build, with and
+    without the LSH mask — phase B is shared, so this pins the folded
+    block maxima to the canonical ones."""
+    import jax
+    import jax.numpy as jnp
+    from oryx_tpu.app.als import serving_model as sm
+
+    rng = np.random.default_rng(71)
+    N, F, W, B, k, bs, ksel = 8192, 20, 128, 8, 8, 128, 8
+    fold = sm._fold_factor(W, F)
+    assert fold == 4
+    Y = np.zeros((N, W), np.float32)
+    Y[:, :F] = rng.standard_normal((N, F)).astype(np.float32)
+    Yj = jnp.asarray(Y)
+    Q = jnp.asarray(rng.standard_normal((B, W)).astype(np.float32)
+                    * np.concatenate([np.ones(F), np.zeros(W - F)]
+                                     ).astype(np.float32))
+    act = np.ones(N, bool)
+    act[::7] = False
+    active = jnp.asarray(act)
+    bkt = jnp.asarray(rng.integers(0, 8, N).astype(np.int32))
+    hp = jnp.asarray(rng.standard_normal((3, W)).astype(np.float32))
+    old_tile = sm._PA_TILE
+    sm._PA_TILE = 2048
+    try:
+        for buckets, hyp, mb in ((None, None, 0), (bkt, hp, 1)):
+            yf, pen_f = sm._fold_items_kernel(Yj, active, fold, bs)
+            bkt_f = sm._fold_buckets_kernel(buckets, fold, bs) \
+                if buckets is not None else None
+            ts_f, ti_f, cert_f = jax.device_get(
+                sm._batch_top_n_twophase_pallas_fold(
+                    Yj, yf, Q, pen_f, active, bkt_f, buckets, hyp,
+                    k, bs, ksel, mb, fold, interpret=True))
+            ts_s, ti_s, cert_s = jax.device_get(
+                sm._batch_top_n_twophase_kernel(
+                    Yj, Q, active, buckets, hyp, k, 2048, bs, ksel, mb))
+            np.testing.assert_allclose(ts_f, ts_s, rtol=1e-5)
+            np.testing.assert_array_equal(ti_f, ti_s)
+            np.testing.assert_array_equal(cert_f, cert_s)
+    finally:
+        sm._PA_TILE = old_tile
