@@ -1220,12 +1220,17 @@ class ALSServingModel(FactorModelBase, ServingModel):
         for qw in windows:
             # fallback chain per shape: folded pallas -> int8 pallas ->
             # bf16/f32 pallas -> lax.scan (a backend that cannot lower
-            # one build must not skip the still-working next one)
+            # one build must not skip the still-working next one).  An
+            # EXPLICIT int8-selection="true" outranks the auto fold —
+            # the operator opted into the quantized mirror's HBM
+            # profile; "auto" int8 yields to fold.
             kinds = []
             if eligible:
+                if want_i8 and self._int8_selection == "true":
+                    kinds.append("i8")
                 if fold > 1:
                     kinds.append("fold")
-                if want_i8:
+                if want_i8 and "i8" not in kinds:
                     kinds.append("i8")
                 kinds.append("pallas")
             dispatched = False
