@@ -295,6 +295,12 @@ def bench_config(features: int, items_m: int, model, user_ids,
         })
         print(json.dumps(rows[-1]), flush=True)
     model.lsh = lsh_obj
+    # drop the class-attribute reference NOW: it otherwise keeps this
+    # cell's device arrays (canonical + fold mirror) alive while the
+    # next config uploads its own matrix — 50f/20M (7.7 GB with the
+    # mirror) still resident under the 250f/20M build (10 GB) is a
+    # measured HBM OOM
+    StaticModelManager.model = None
     return rows
 
 
