@@ -474,6 +474,11 @@ ROUTES = [
     Route("GET", "/mostActiveUsers", _most_active_users),
     Route("GET", "/mostPopularItems", _most_popular_items),
     Route("GET", "/popularRepresentativeItems", _popular_representative_items),
+    # reference-exact paths (AllUserIDs.java:33-37 is @Path("/user") +
+    # @Path("/allIDs") -> /user/allIDs; likewise /item/allIDs); the
+    # flat spellings are kept as aliases
+    Route("GET", "/user/allIDs", _all_user_ids),
+    Route("GET", "/item/allIDs", _all_item_ids),
     Route("GET", "/allUserIDs", _all_user_ids),
     Route("GET", "/allItemIDs", _all_item_ids),
     Route("GET", "/knownItems/{userID}", _known_items),

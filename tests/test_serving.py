@@ -204,6 +204,10 @@ def test_popular_representative_items(server):
 def test_all_ids(server):
     assert sorted(_get(server, "/allUserIDs")) == [f"U{i}" for i in range(8)]
     assert len(_get(server, "/allItemIDs")) == 12
+    # reference-exact paths (AllUserIDs.java:33-37: /user/allIDs)
+    assert sorted(_get(server, "/user/allIDs")) == \
+        sorted(_get(server, "/allUserIDs"))
+    assert _get(server, "/item/allIDs") == _get(server, "/allItemIDs")
 
 
 def test_pref_post_and_delete_write_input(server):
