@@ -1,0 +1,161 @@
+"""Phase-A LSH-mask layout experiment (diagnostic, not product code).
+
+The LSH cells' phase A runs ~1.5x the exact scan (r05: 31 vs 20-24 ms
+per 256-window at 20M) and the suspect is not the popcount itself but
+the LAYOUT of the mask: scores come out of the MXU as (T, B) with B on
+lanes, while the per-row bucket ids live lane-aligned as (T//bs, bs) —
+broadcasting a bucket against all B lanes forces a per-element
+cross-lane relayout.  Variant B computes scores transposed, (B, T), so
+the bucket vector broadcasts along SUBLANES (one cheap flatten per
+tile) and the block max reduces over lanes.
+
+Usage: python docs/bench_diag/lsh_mask_probe.py [--items-m 20]
+Prints one JSON line per variant (exec_ms via the m-deep queue method).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from functools import partial
+
+sys.path.insert(0, ".")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.experimental import pallas as pl  # noqa: E402
+
+from oryx_tpu.bench.kernel_probe import time_exec  # noqa: E402
+
+T = 4096
+BS = 128
+MB = 1
+
+
+@partial(jax.jit, static_argnames=("mb",))
+def variant_a(Y, Qc, pen, bkt, tgt, mb: int):
+    """Current product formulation: (T, B) scores, 3D-broadcast mask."""
+    N, W = Y.shape
+    B = Qc.shape[0]
+
+    def kern(q_ref, y_ref, p_ref, b_ref, t_ref, o_ref):
+        s = jax.lax.dot_general(y_ref[...], q_ref[...],
+                                (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s3 = s.reshape(T // BS, BS, B) + p_ref[...][:, :, None]
+        ok = jax.lax.population_count(
+            jnp.bitwise_xor(b_ref[...][:, :, None],
+                            t_ref[...][0][None, None, :])) <= mb
+        s3 = jnp.where(ok, s3, -jnp.inf)
+        o_ref[...] = s3.max(1)
+
+    return pl.pallas_call(
+        kern, grid=(N // T,),
+        in_specs=[pl.BlockSpec((B, W), lambda i: (0, 0)),
+                  pl.BlockSpec((T, W), lambda i: (i, 0)),
+                  pl.BlockSpec((T // BS, BS), lambda i: (i, 0)),
+                  pl.BlockSpec((T // BS, BS), lambda i: (i, 0)),
+                  pl.BlockSpec((1, B), lambda i: (0, 0))],
+        out_specs=pl.BlockSpec((T // BS, B), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((N // BS, B), jnp.float32),
+    )(Qc, Y, pen, bkt, tgt)
+
+
+@partial(jax.jit, static_argnames=("mb",))
+def variant_b(Y, Qc, pen, bkt, tgt, mb: int):
+    """Transposed: (B, T) scores; bucket/penalty flatten to (1, T) once
+    per tile and broadcast along sublanes; block max over lanes; small
+    (B, T//BS) -> (T//BS, B) transpose before the store."""
+    N, W = Y.shape
+    B = Qc.shape[0]
+
+    def kern(q_ref, y_ref, p_ref, b_ref, t_ref, o_ref):
+        s = jax.lax.dot_general(q_ref[...], y_ref[...],
+                                (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        bb = b_ref[...].reshape(1, T)
+        pp = p_ref[...].reshape(1, T)
+        tq = t_ref[...].reshape(B, 1)
+        ok = jax.lax.population_count(jnp.bitwise_xor(bb, tq)) <= mb
+        s = jnp.where(ok, s + pp, -jnp.inf)
+        m = s.reshape(B, T // BS, BS).max(-1)
+        o_ref[...] = m.T
+
+    return pl.pallas_call(
+        kern, grid=(N // T,),
+        in_specs=[pl.BlockSpec((B, W), lambda i: (0, 0)),
+                  pl.BlockSpec((T, W), lambda i: (i, 0)),
+                  pl.BlockSpec((T // BS, BS), lambda i: (i, 0)),
+                  pl.BlockSpec((T // BS, BS), lambda i: (i, 0)),
+                  pl.BlockSpec((1, B), lambda i: (0, 0))],
+        out_specs=pl.BlockSpec((T // BS, B), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((N // BS, B), jnp.float32),
+    )(Qc, Y, pen, bkt, tgt)
+
+
+@jax.jit
+def variant_exact(Y, Qc, pen):
+    """No mask: the floor both variants chase."""
+    N, W = Y.shape
+    B = Qc.shape[0]
+
+    def kern(q_ref, y_ref, p_ref, o_ref):
+        s = jax.lax.dot_general(y_ref[...], q_ref[...],
+                                (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s3 = s.reshape(T // BS, BS, B) + p_ref[...][:, :, None]
+        o_ref[...] = s3.max(1)
+
+    return pl.pallas_call(
+        kern, grid=(N // T,),
+        in_specs=[pl.BlockSpec((B, W), lambda i: (0, 0)),
+                  pl.BlockSpec((T, W), lambda i: (i, 0)),
+                  pl.BlockSpec((T // BS, BS), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((T // BS, B), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((N // BS, B), jnp.float32),
+    )(Qc, Y, pen)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--items-m", type=float, default=20.0)
+    ap.add_argument("--features", type=int, default=50)
+    ap.add_argument("--batch", type=int, default=256)
+    args = ap.parse_args()
+    N = int(args.items_m * 1e6) // T * T
+    W, B = 128, args.batch
+
+    key = jax.random.PRNGKey(0)
+    kY, kQ, kb, kt = jax.random.split(key, 4)
+    # dense random lanes (the real snapshot zeroes lanes >= features,
+    # which changes score values but not the kernels' work or layout;
+    # zeroing in-place here would transiently double the 5.1 GB array)
+    Y = jax.random.normal(kY, (N, W), jnp.bfloat16)
+    Qc = jax.random.normal(kQ, (B, W), jnp.bfloat16)
+    pen = jnp.zeros((N // BS, BS), jnp.float32)
+    bkt = jax.random.randint(kb, (N // BS, BS), 0, 128, jnp.int32)
+    tgt = jax.random.randint(kt, (1, B), 0, 128, jnp.int32)
+    jax.block_until_ready((Y, Qc, pen, bkt, tgt))
+
+    # correctness: variants must agree bit-for-bit
+    a = jax.device_get(variant_a(Y, Qc, pen, bkt, tgt, MB))
+    b = jax.device_get(variant_b(Y, Qc, pen, bkt, tgt, MB))
+    assert np.array_equal(a, b, equal_nan=True), "variant mismatch"
+
+    for name, fn in (
+            ("exact_floor", lambda: variant_exact(Y, Qc, pen)),
+            ("mask_3d_current", lambda: variant_a(Y, Qc, pen, bkt, tgt,
+                                                  MB)),
+            ("mask_2d_transposed", lambda: variant_b(Y, Qc, pen, bkt,
+                                                     tgt, MB))):
+        # shallow queue: each queued program holds a 160 MB (N//BS, B)
+        # f32 output next to the 5.1 GB item matrix
+        t = time_exec(fn, jax.device_get, m=4, min_delta_ms=20.0)
+        t["variant"] = name
+        print(json.dumps(t), flush=True)
+
+
+if __name__ == "__main__":
+    main()
