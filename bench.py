@@ -118,9 +118,15 @@ def main() -> None:
             if not o["sustained"]:
                 break
         if not any(o["sustained"] for o in ladder):
+            # same 25 qps floor as grid.bench_config's ladder: below it
+            # a 6 s window has too few arrivals for the kept-up gate;
+            # dedupe so a low closed-loop qps doesn't re-bench the
+            # floored rate three times
             descend_until_sustained(
                 base, user_ids,
-                [stats.qps * m for m in (0.7, 0.5, 0.35)], ladder,
+                list(dict.fromkeys(
+                    max(25.0, stats.qps * m) for m in (0.7, 0.5, 0.35))),
+                ladder,
                 duration_sec=6.0, workers=HTTP_WORKERS, how_many=TOP_N)
         open_loop_sustained = max(
             (o["offered_qps"] for o in ladder if o["sustained"]),

@@ -815,7 +815,12 @@ class ALSServingModel(FactorModelBase, ServingModel):
         # phase A halves HBM bytes and doubles MXU rate (11.6 -> 5.3 ms
         # measured), but bound bookkeeping + the doubled selection
         # width return the gain end to end on this chip — kept as a
-        # measured, certificate-sound capability, not the default path
+        # measured, certificate-sound capability, not the default path.
+        # Programmatic booleans normalize to the canonical strings so a
+        # True opt-in gets the same explicit-outranks-auto-fold
+        # precedence as "true" (the dispatch chain compares strings)
+        if isinstance(int8_selection, bool):
+            int8_selection = "true" if int8_selection else "false"
         self._int8_selection = int8_selection
         self._i8: tuple | None = None
         self._i8_version: int = -1

@@ -100,6 +100,7 @@ def _child(args) -> None:
     t_query = time.perf_counter()
 
     print(json.dumps({
+        "jax_version": jax.__version__,
         "backend_up_s": round(t_backend - t_proc, 2),
         "synth_s": round(t_synth - t_backend, 2),
         "epoch1_s": round(epoch_times[0], 2),
@@ -160,17 +161,25 @@ def main(argv: list[str] | None = None) -> None:
 
             # count UNIQUE cache keys: the child's logging setup emits
             # every record twice (timestamped handler + plain root),
-            # so a raw line count double-counts each event
+            # so a raw line count double-counts each event.  Match is
+            # deliberately loose ("cache miss ... key '<key>'" in any
+            # casing/wording order) so a jax release that rewords its
+            # private jax._src.compiler debug lines still counts.
             text = out.stdout + out.stderr
             misses = len(set(re.findall(
-                r"CACHE MISS for '[^']+' with key '([^']+)'", text)))
+                r"(?i)cache miss\b[^'\n]*'[^']*'[^'\n]*'([^']+)'", text)))
             hits = len(set(re.findall(
-                r"cache hit for '[^']+' with key '([^']+)'", text)))
+                r"(?i)cache hit\b[^'\n]*'[^']*'[^'\n]*'([^']+)'", text)))
 
     cold, warm = runs
     result = {
         "metric": "als_cold_start",
         "ratings": args.ratings, "rank": args.rank,
+        # which jax produced/parsed the cache-log lines: a wording
+        # change that flips warm_restart_ok is diagnosable from the
+        # artifact alone (raw hit/miss counts ride in
+        # second_cold_cache_log below)
+        "jax_version": warm.get("jax_version"),
         "cache_dir": cache_dir,
         "cold": cold, "second_cold": warm,
         "compile_overhead_cold_s": cold["compile_overhead_s"],

@@ -30,6 +30,21 @@ from typing import IO
 
 from . import io_utils
 from .io_utils import strip_scheme
+from ..resilience.faults import fire as _fault
+from ..resilience.policy import Backoff, Retry
+
+# model/data publishes route through here; a transient filesystem or
+# object-store hiccup on the final rename must not cost a whole trained
+# generation, so the publish step retries briefly before surfacing.
+# Deterministic outcomes (bad path, permissions) are NOT transient and
+# must surface immediately, not after the whole backoff schedule.
+_DETERMINISTIC_OS_ERRORS = (FileNotFoundError, PermissionError,
+                            NotADirectoryError, IsADirectoryError)
+_io_retry = Retry(
+    "store-io",
+    retryable=lambda e: (isinstance(e, OSError)
+                         and not isinstance(e, _DETERMINISTIC_OS_ERRORS)),
+    max_attempts=3, backoff=Backoff(initial=0.02, maximum=0.2))
 
 __all__ = [
     "is_local", "open_read", "open_write", "exists", "getsize",
@@ -81,6 +96,9 @@ def open_read(uri: str, mode: str = "rb") -> IO:
 
 
 def open_write(uri: str, mode: str = "wb") -> IO:
+    # chaos seam: transient write failure (full disk, flaky mount)
+    _fault("store-write", error=lambda: OSError(
+        f"injected write failure for {uri}"))
     if is_local(uri):
         path = strip_scheme(uri)
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
@@ -141,9 +159,24 @@ def rename(src_uri: str, dst_uri: str) -> None:
     eventual-visibility contract the reference relies on HDFS rename
     for (readers only learn the path from the update topic *after* the
     move completes)."""
-    if is_local(src_uri) and is_local(dst_uri):
-        os.replace(strip_scheme(src_uri), strip_scheme(dst_uri))
-        return
-    fs, src = _fs(src_uri)
-    _, dst = _fs(dst_uri)
-    fs.mv(src, dst, recursive=True)
+    def _do() -> None:
+        # chaos seam: transient rename failure on the publish edge
+        _fault("store-rename", error=lambda: OSError(
+            f"injected rename failure for {dst_uri}"))
+        try:
+            if is_local(src_uri) and is_local(dst_uri):
+                os.replace(strip_scheme(src_uri), strip_scheme(dst_uri))
+                return
+            fs, src = _fs(src_uri)
+            _, dst = _fs(dst_uri)
+            fs.mv(src, dst, recursive=True)
+        except FileNotFoundError:
+            # a RETRIED rename whose earlier attempt actually completed
+            # (the ack was lost, the move was not): src gone + dst
+            # present IS the published state — report success, don't
+            # fail a generation whose artifact is already live
+            if not exists(src_uri) and exists(dst_uri):
+                return
+            raise
+
+    _io_retry.call(_do)

@@ -29,8 +29,27 @@ def _load_config(conf: str | None) -> Config:
     return from_file(conf) if conf else get_default()
 
 
-def _run_layer(layer) -> None:
+def _run_layer(make_layer, name: str, config: Config) -> None:
+    """Run a layer to completion; with the supervisor enabled (the
+    default, oryx.resilience.supervisor.*) a layer whose worker thread
+    dies — anything harsher than the Exceptions the layers survive
+    internally — is rebuilt and restarted with backoff instead of
+    leaving a silently-dead process behind."""
+    from ..resilience.policy import Supervisor
     hook = ShutdownHook()
+    if config.get_bool("oryx.resilience.supervisor.enabled"):
+        supervisor = Supervisor.from_config(make_layer, name, config)
+
+        class _Stop:  # close() both halts the supervisor loop and the
+            def close(self):  # current layer, for the shutdown hook
+                supervisor.stop()
+                if supervisor.layer is not None:
+                    supervisor.layer.close()
+
+        hook.add_close_at_shutdown(_Stop())
+        supervisor.run()
+        return
+    layer = make_layer()
     hook.add_close_at_shutdown(layer)
     layer.start()
     try:
@@ -43,19 +62,22 @@ def _run_layer(layer) -> None:
 
 def _cmd_batch(args) -> int:
     from ..lambda_rt.batch import BatchLayer
-    _run_layer(BatchLayer(_load_config(args.conf)))
+    config = _load_config(args.conf)
+    _run_layer(lambda: BatchLayer(config), "batch", config)
     return 0
 
 
 def _cmd_speed(args) -> int:
     from ..lambda_rt.speed import SpeedLayer
-    _run_layer(SpeedLayer(_load_config(args.conf)))
+    config = _load_config(args.conf)
+    _run_layer(lambda: SpeedLayer(config), "speed", config)
     return 0
 
 
 def _cmd_serving(args) -> int:
     from ..lambda_rt.serving import ServingLayer
-    _run_layer(ServingLayer(_load_config(args.conf)))
+    config = _load_config(args.conf)
+    _run_layer(lambda: ServingLayer(config), "serving", config)
     return 0
 
 

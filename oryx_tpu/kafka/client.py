@@ -31,11 +31,26 @@ import threading
 import time
 from typing import Iterator
 
+from ..resilience.policy import Backoff, Retry
 from .api import KeyMessage, TopicProducer
 from .wire import KafkaProtocolError, WireKafkaClient
 
 __all__ = ["kafka_client_available", "get_kafka_broker", "KafkaBroker",
-           "KafkaTopicProducer"]
+           "KafkaTopicProducer", "is_transient_kafka_error"]
+
+# error codes a client should retry: the broker is alive but this
+# request lost a race it will win on a later attempt (leadership moved,
+# request timed out, coordinator still loading)
+_TRANSIENT_CODES = {6, 7, 15}
+
+
+def is_transient_kafka_error(e: BaseException) -> bool:
+    """Retry policy for broker I/O: connection-level failures and the
+    transient Kafka error codes; everything else (bad request, unknown
+    topic...) is a caller bug and must surface immediately."""
+    if isinstance(e, KafkaProtocolError):
+        return e.code in _TRANSIENT_CODES
+    return isinstance(e, (ConnectionError, OSError, TimeoutError))
 
 _BROKERS: dict[str, "KafkaBroker"] = {}
 _BROKERS_LOCK = threading.Lock()
@@ -104,6 +119,13 @@ class KafkaBroker:
     def __init__(self, bootstrap: str):
         self.bootstrap = bootstrap
         self._client = WireKafkaClient(bootstrap)
+        # transient broker errors (timed out, leader moved, coordinator
+        # loading, connection died) retry with backoff instead of
+        # failing the layer's whole generation; stats feed /metrics
+        self._retry = Retry(f"kafka-client[{bootstrap}]",
+                            retryable=is_transient_kafka_error,
+                            max_attempts=5,
+                            backoff=Backoff(initial=0.05, maximum=1.0))
         self._lock = threading.Lock()
         # sticky per-topic round-robin pointer for unkeyed sends
         self._rr: dict[str, int] = {}
@@ -161,8 +183,11 @@ class KafkaBroker:
                 i = self._rr.get(topic, 0)
                 self._rr[topic] = i + 1
             p = parts[i % len(parts)]
-        return self._client.produce(topic, p,
-                                    [(_enc(key), _enc(message))])
+        # retried produce can duplicate a record the broker acked but
+        # whose ack was lost — at-least-once, same as every layer's
+        # delivery contract (docs/RESILIENCE.md)
+        return self._retry.call(self._client.produce, topic, p,
+                                [(_enc(key), _enc(message))])
 
     def latest_offset(self, topic: str) -> int:
         offs = self.latest_offsets(topic)
@@ -173,7 +198,7 @@ class KafkaBroker:
         return offs[0]
 
     def latest_offsets(self, topic: str) -> list[int]:
-        return [self._client.list_offset(topic, p, -1)
+        return [self._retry.call(self._client.list_offset, topic, p, -1)
                 for p in self._partitions(topic)]
 
     def read_range(self, topic: str, start: int, end: int) -> list[KeyMessage]:
@@ -212,7 +237,8 @@ class KafkaBroker:
                         raise TimeoutError(
                             f"drained only [{s}, {pos}) of [{s}, {e}) "
                             f"from {topic}/p{p} within 30s")
-                    recs = c.fetch(topic, p, pos, max_wait_ms=500)
+                    recs = self._retry.call(c.fetch, topic, p, pos,
+                                            max_wait_ms=500)
                     for off, key, value in recs:
                         if off >= e:
                             break
@@ -257,8 +283,8 @@ class KafkaBroker:
 
         def _fetch(p: int) -> list:
             try:
-                return c.fetch(topic, p, positions[p],
-                               max_wait_ms=wait_ms)
+                return self._retry.call(c.fetch, topic, p, positions[p],
+                                        max_wait_ms=wait_ms)
             except KafkaProtocolError as e:
                 if e.code != 1:  # OFFSET_OUT_OF_RANGE
                     raise
@@ -312,13 +338,15 @@ class KafkaBroker:
 
     def set_offset(self, group: str, topic: str, offset: int,
                    partition: int = 0) -> None:
-        self._coordinator(group).offset_commit(group, topic,
-                                               {partition: offset})
+        self._retry.call(self._coordinator(group).offset_commit, group,
+                         topic, {partition: offset})
 
     def set_offsets(self, group: str, topic: str,
                     offsets: list[int]) -> None:
-        self._coordinator(group).offset_commit(group, topic,
-                                               dict(enumerate(offsets)))
+        # a commit lost to a transient failure is only redelivery
+        # (at-least-once), but retrying here keeps the window narrow
+        self._retry.call(self._coordinator(group).offset_commit, group,
+                         topic, dict(enumerate(offsets)))
 
     def fill_in_latest_offsets(self, group: str, topics: list[str]) -> None:
         for topic in topics:

@@ -42,6 +42,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Iterator
 
 from ..common.io_utils import mkdirs
+from ..resilience import faults
 from .api import KeyMessage, TopicProducer
 
 __all__ = ["InProcBroker", "get_broker", "resolve_broker", "InProcTopicProducer"]
@@ -369,8 +370,17 @@ class InProcBroker:
     def send(self, topic: str, key: str | None, message: str) -> int:
         """Append to the key's partition; returns the record's offset
         within that partition."""
+        # chaos seam: error (broker down), delay (slow broker), or
+        # duplicate (producer-retry redelivery — Kafka's at-least-once)
+        action = faults.fire("inproc-send")
+        if action == "drop":
+            return -1  # acked but lost: the fault a durable log rules out
         t = self._topic(topic)
-        return t.partitions[t.partition_for(key)].append(key, message)
+        p = t.partitions[t.partition_for(key)]
+        offset = p.append(key, message)
+        if action == "duplicate":
+            offset = p.append(key, message)
+        return offset
 
     def latest_offset(self, topic: str) -> int:
         """Single-partition convenience; multi-partition topics must use
@@ -403,6 +413,7 @@ class InProcBroker:
         concurrently (P7 parallel ingest), results concatenated in
         partition order — per-partition record order is preserved,
         cross-partition order is unspecified (Kafka's guarantee)."""
+        faults.fire("inproc-read")  # chaos seam: drain failure mid-fetch
         t = self._topic(topic)
         n = t.num_partitions
         if len(starts) != n or len(ends) != n:
@@ -502,12 +513,14 @@ class InProcBroker:
 
     def set_offset(self, group: str, topic: str, offset: int,
                    partition: int = 0) -> None:
+        faults.fire("inproc-commit")  # chaos seam: commit failure
         with self._lock:
             self._offsets[(group, topic, partition)] = offset
             self._maybe_write_offsets_locked()
 
     def set_offsets(self, group: str, topic: str,
                     offsets: list[int]) -> None:
+        faults.fire("inproc-commit")  # chaos seam: commit failure
         with self._lock:
             for p, off in enumerate(offsets):
                 self._offsets[(group, topic, p)] = off

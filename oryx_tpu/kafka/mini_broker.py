@@ -21,6 +21,7 @@ import struct
 import threading
 import time
 
+from ..resilience import faults
 from .wire import (API_API_VERSIONS, API_CREATE_TOPICS, API_DELETE_TOPICS,
                    API_FETCH, API_FIND_COORD, API_LIST_OFFSETS,
                    API_METADATA, API_OFFSET_COMMIT, API_OFFSET_FETCH,
@@ -95,6 +96,11 @@ class MiniKafkaBroker:
                 r = Reader(payload)
                 api_key, api_version, corr = r.i16(), r.i16(), r.i32()
                 r.string()  # client id
+                # chaos seam: broker dies after reading a request but
+                # before answering — the client cannot know whether the
+                # operation happened (the ambiguity at-least-once covers)
+                if faults.fire("mini-broker-drop") == "drop":
+                    return
                 body = self._dispatch(api_key, api_version, r)
                 out = Writer().i32(corr).raw(body).getvalue()
                 conn.sendall(struct.pack("!i", len(out)) + out)
@@ -190,6 +196,9 @@ class MiniKafkaBroker:
         r.string()                          # transactional id
         r.i16()                             # acks
         r.i32()                             # timeout
+        # chaos seam: answer REQUEST_TIMED_OUT without appending — the
+        # transient error code a loaded real broker returns
+        inject_err = faults.fire("mini-broker-produce-error") == "drop"
         results = []
         with self._data_event:
             for _ in range(r.i32()):
@@ -198,6 +207,9 @@ class MiniKafkaBroker:
                     p = r.i32()
                     batch = r.bytes_()
                     topic = self._topics.get(name)
+                    if inject_err:
+                        results.append((name, p, 7, -1))
+                        continue
                     if topic is None or p >= len(topic.parts):
                         results.append((name, p, 3, -1))
                         continue
@@ -240,9 +252,16 @@ class MiniKafkaBroker:
                     return True
             return False
 
+        # chaos seam: transient fetch failure (same code a rebalancing
+        # or overloaded broker would return for this partition).
+        # Distinct from the produce point: concurrent traffic must not
+        # steal a one-shot activation aimed at the other seam.
+        inject_err = faults.fire("mini-broker-fetch-error") == "drop"
         deadline = time.monotonic() + max_wait / 1000.0
         with self._data_event:
             while not have_data():
+                if inject_err:
+                    break
                 left = deadline - time.monotonic()
                 if left <= 0:
                     break
@@ -254,6 +273,10 @@ class MiniKafkaBroker:
                 t = self._topics.get(name)
                 w.string(name)
                 w.i32(1)
+                if inject_err:
+                    w.i32(p).i16(7).i64(-1).i64(-1).i32(0)
+                    w.bytes_(None)
+                    continue
                 if t is None or p >= len(t.parts):
                     w.i32(p).i16(3).i64(-1).i64(-1).i32(0)
                     w.bytes_(None)

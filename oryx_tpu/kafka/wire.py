@@ -34,6 +34,8 @@ import socket
 import struct
 import threading
 
+from ..resilience import faults
+
 __all__ = [
     "KafkaProtocolError", "WireKafkaClient",
     "encode_record_batch", "decode_record_batches", "crc32c",
@@ -352,6 +354,12 @@ class _Conn:
 
     def request(self, api_key: int, api_version: int, body: bytes,
                 timeout: float | None = None) -> Reader:
+        # chaos seam: broker connection dies before the request is sent
+        if faults.fire("wire-send",
+                       error=lambda: ConnectionError(
+                           "injected connection drop")) == "drop":
+            self.close()
+            raise ConnectionError("injected connection drop")
         with self._lock:
             self._corr += 1
             corr = self._corr
@@ -377,6 +385,12 @@ class _Conn:
         return self._read_n(size)
 
     def _read_n(self, n: int) -> bytes:
+        # chaos seam: "drop" consumes part of the frame then kills the
+        # connection — a mid-read broker death leaves the stream
+        # desynced, exactly the case reconnect-and-retry must cover
+        partial = faults.fire("wire-read",
+                              error=lambda: ConnectionError(
+                                  "injected read failure")) == "drop"
         chunks = []
         while n:
             got = self.sock.recv(n)
@@ -384,6 +398,9 @@ class _Conn:
                 raise ConnectionError("broker closed connection")
             chunks.append(got)
             n -= len(got)
+            if partial:
+                self.close()
+                raise ConnectionError("injected partial read")
         return b"".join(chunks)
 
     def close(self) -> None:

@@ -21,6 +21,7 @@ from ..common.lang import load_instance
 from ..kafka import utils as kafka_utils
 from ..kafka.api import KeyMessage
 from ..kafka.inproc import InProcTopicProducer, resolve_broker
+from ..resilience import faults
 from . import data_store
 
 _log = logging.getLogger(__name__)
@@ -51,6 +52,8 @@ class BatchLayer:
         self._group = f"OryxGroup-BatchLayer-{self.id or 'default'}"
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # config-staged chaos (oryx.resilience.faults.*); empty = no-op
+        faults.configure_from_config(config)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -88,12 +91,41 @@ class BatchLayer:
 
     # -- one generation ------------------------------------------------------
 
+    def _recover_offsets(self, broker) -> None:
+        """Crash recovery: complete an interrupted offset commit.
+
+        Each generation file carries the input end-offsets it covers in
+        its header (the same atomic rename as the data).  If the newest
+        saved generation records ends PAST the committed offsets, the
+        previous process died between its save and its commit; those
+        records are already durable as past data, so re-reading them as
+        new input would feed the update duplicated records.  Advancing
+        the commit to the saved ends finishes the interrupted
+        generation's bookkeeping — never rewinds, and a header behind
+        the committed offsets (normal shutdown) is a no-op."""
+        saved = data_store.last_saved_offsets(self.data_dir)
+        ends = (saved or {}).get(self.input_topic)
+        if not ends:
+            return
+        committed = broker.get_offsets(self._group, self.input_topic)
+        if len(committed) != len(ends):
+            return  # partition layout changed: offsets not comparable
+        merged = [max(e, c if c is not None else 0)
+                  for e, c in zip(ends, committed)]
+        if merged != [c if c is not None else 0 for c in committed]:
+            _log.warning(
+                "Recovering interrupted offset commit for %s: %s -> %s",
+                self.input_topic, committed, merged)
+            broker.set_offsets(self._group, self.input_topic, merged)
+            broker.flush()
+
     def run_one_generation(self) -> None:
         """Drain new input, persist it, run the update over (new, past),
         then commit offsets and apply TTLs — commit ordering gives
         at-least-once with idempotent overwrite (reference semantics)."""
         timestamp_ms = int(time.time() * 1000)
         broker = resolve_broker(self.input_broker)
+        self._recover_offsets(broker)
         # per-partition offsets (P7 — reference: UpdateOffsetsFn.java:
         # 37-64 commits per (topic, partition)); first run reads each
         # partition from the beginning, partitions drain concurrently
@@ -118,10 +150,18 @@ class BatchLayer:
         # exactly the same (new, past) split instead of duplicated input
         self.update_instance.run_update(timestamp_ms, new_data, past_data,
                                         self.model_dir, producer)
-        data_store.save_generation(self.data_dir, timestamp_ms, new_data)
+        # chaos seam: die after the model was published but before the
+        # generation is durable — retry must reprocess the same input
+        faults.fire("batch-crash-after-update")
+        data_store.save_generation(self.data_dir, timestamp_ms, new_data,
+                                   end_offsets={self.input_topic: ends})
+        # chaos seam: die between the durable save and the offset
+        # commit — the window _recover_offsets exists for
+        faults.fire("batch-crash-before-commit")
         # offsets commit only after the update completed (at-least-once)
         broker.set_offsets(self._group, self.input_topic, ends)
         broker.flush()
+        faults.fire("batch-crash-after-commit")
 
         data_store.delete_old_data(self.data_dir, self.max_age_data_hours)
         data_store.delete_old_models(self.model_dir, self.max_age_model_hours)

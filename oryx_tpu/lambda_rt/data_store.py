@@ -29,16 +29,26 @@ from ..kafka.api import KeyMessage
 
 _log = logging.getLogger(__name__)
 
-__all__ = ["save_generation", "read_all_data", "delete_old_data",
-           "delete_old_models"]
+__all__ = ["save_generation", "read_all_data", "last_saved_offsets",
+           "delete_old_data", "delete_old_models"]
 
 _DATA_FILE_RE = re.compile(r"^oryx-(\d+)\.data\.jsonl\.gz$")
 
 
 def save_generation(data_dir: str, timestamp_ms: int,
-                    data: Sequence[KeyMessage]) -> str | None:
+                    data: Sequence[KeyMessage],
+                    end_offsets: dict[str, list[int]] | None = None
+                    ) -> str | None:
     """Write one generation's input; idempotent (a partial earlier
-    attempt is replaced, as the reference deletes partial output)."""
+    attempt is replaced, as the reference deletes partial output).
+
+    ``end_offsets`` ({topic: per-partition end offsets}) rides in the
+    file's first line, INSIDE the same atomic rename as the data: a
+    crash between this save and the broker offset commit would
+    otherwise make the next generation read these records both as past
+    data (from this file) and as new data (from the uncommitted input
+    range) — the batch layer reconciles from this header on start
+    (:func:`last_saved_offsets`, BatchLayer._recover_offsets)."""
     if not data:
         return None
     store.mkdirs(data_dir)
@@ -46,10 +56,34 @@ def save_generation(data_dir: str, timestamp_ms: int,
     tmp = path + ".tmp"
     with store.open_write(tmp) as raw, \
             gzip.open(raw, "wt", encoding="utf-8") as f:
+        if end_offsets:
+            f.write(json.dumps({"end_offsets": end_offsets}) + "\n")
         for km in data:
             f.write(json.dumps([km.key, km.message]) + "\n")
     store.rename(tmp, path)
     return path
+
+
+def last_saved_offsets(data_dir: str) -> dict[str, list[int]] | None:
+    """The newest generation file's covered input end-offsets, or None
+    (no data, or files written before headers existed)."""
+    paths = [p for p in store.glob(data_dir, "oryx-*.data.jsonl.gz")
+             if _DATA_FILE_RE.match(os.path.basename(p))]
+    if not paths:
+        return None
+    newest = max(paths, key=lambda p: int(
+        _DATA_FILE_RE.match(os.path.basename(p)).group(1)))
+    with store.open_read(newest) as raw, \
+            gzip.open(raw, "rt", encoding="utf-8") as f:
+        first = f.readline()
+    try:
+        obj = json.loads(first) if first.strip() else None
+    except ValueError:
+        return None
+    if isinstance(obj, dict) and "end_offsets" in obj:
+        return {t: [int(o) for o in offs]
+                for t, offs in obj["end_offsets"].items()}
+    return None
 
 
 def read_all_data(data_dir: str,
@@ -67,8 +101,10 @@ def read_all_data(data_dir: str,
                 gzip.open(raw, "rt", encoding="utf-8") as f:
             for line in f:
                 if line.strip():
-                    k, msg = json.loads(line)
-                    out.append(KeyMessage(k, msg))
+                    rec = json.loads(line)
+                    if isinstance(rec, dict):
+                        continue  # offsets header, not a record
+                    out.append(KeyMessage(rec[0], rec[1]))
     return out
 
 

@@ -27,6 +27,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, NamedTuple
 
 from ..api.serving import HasCSV, OryxServingException
+from ..resilience.policy import Deadline, DeadlineExceeded
 
 __all__ = ["Route", "Request", "HttpApp", "json_or_csv", "HtmlResponse",
            "TextResponse", "render_error_page"]
@@ -96,6 +97,12 @@ class Request(NamedTuple):
     body: bytes
     headers: dict[str, str]
     context: dict[str, Any]       # app-scope objects (model manager, producer...)
+    # per-call deadline (resilience.policy.Deadline) minted at the front
+    # end from oryx.resilience.request-deadline-ms and/or the client's
+    # X-Deadline-Ms header; None = unbounded.  Handlers thread it into
+    # queueing work (the scoring micro-batcher) so an expired request is
+    # refused (503) instead of queueing to die.
+    deadline: Any = None
 
     def q1(self, name: str, default: str | None = None) -> str | None:
         vals = self.query.get(name)
@@ -169,7 +176,8 @@ class HttpApp:
     def __init__(self, routes: list[Route], context: dict[str, Any],
                  read_only: bool = False,
                  user_name: str | None = None, password: str | None = None,
-                 context_path: str = "/"):
+                 context_path: str = "/",
+                 request_deadline_ms: int = 0):
         self._routes = [(r, _compile(r.pattern)) for r in routes]
         self.context = context
         # single injection point: the dispatcher records into the same
@@ -180,8 +188,28 @@ class HttpApp:
         self.password = password
         self.realm = "Oryx"
         self.context_path = "" if context_path in ("/", "") else context_path.rstrip("/")
+        self.request_deadline_ms = request_deadline_ms
         self._nonces: set[str] = set()
         self._nonce_lock = threading.Lock()
+
+    def _deadline(self, handler):
+        """Mint the per-request Deadline: the tighter of the configured
+        default and the client's X-Deadline-Ms header (a client's bound
+        may only shrink the server's, never extend it)."""
+        ms = self.request_deadline_ms if self.request_deadline_ms > 0 \
+            else None
+        hdr = handler.headers.get("X-Deadline-Ms")
+        if hdr:
+            try:
+                client_ms = int(hdr)
+            except ValueError:
+                client_ms = None
+            if client_ms is not None and client_ms >= 0:
+                # 0 is a valid (already expired) budget, not "none"
+                ms = client_ms if ms is None else min(ms, client_ms)
+        if ms is None:
+            return None
+        return Deadline.after(ms / 1000.0)
 
     # -- auth (DIGEST, reference: InMemoryRealm + DIGEST auth config) -------
 
@@ -313,11 +341,18 @@ class HttpApp:
                                      "Content-Encoding gzip but body is not")
                     return
             req = Request(method, path, m.groupdict(), query, body,
-                          dict(handler.headers), self.context)
+                          dict(handler.headers), self.context,
+                          deadline=self._deadline(handler))
             try:
                 result = route.handler(req)
             except OryxServingException as e:
                 self._send_error(handler, e.status, str(e))
+                return
+            except DeadlineExceeded as e:
+                # the request's time budget ran out while queued or in
+                # flight: shed it (the lambda 503 contract) rather than
+                # report a server fault
+                self._send_error(handler, 503, str(e))
                 return
             except (ValueError, KeyError) as e:
                 self._send_error(handler, 400, f"bad request: {e}")

@@ -20,6 +20,9 @@ from ..common.config import Config
 from ..common.lang import load_instance, logging_call
 from ..kafka import utils as kafka_utils
 from ..kafka.inproc import InProcTopicProducer, resolve_broker
+from ..resilience import faults
+from ..resilience.policy import (CircuitBreaker, ResilientTopicProducer,
+                                 Retry, run_with_resubscribe)
 from ..serving.batcher import TopNBatcher
 from .http import HttpApp, Route, make_server
 from .metrics import MetricsRegistry
@@ -71,14 +74,23 @@ class ServingLayer:
         self._server = None
         self._server_thread: threading.Thread | None = None
 
+        faults.configure_from_config(config)
         self.input_producer = None
+        # breaker around the serving tier's broker writes: a dead input
+        # broker degrades /ingest//pref to fast 503s instead of stacking
+        # blocked handler threads, and the half-open probe restores
+        # service without a restart (tests/test_resilience_it.py)
+        self.input_breaker = CircuitBreaker.from_config(
+            "serving-input", config)
         if not self.read_only and self.input_broker and self.input_topic:
             if not self.no_init_topics:
                 kafka_utils.maybe_create_topic(
                     self.input_broker, self.input_topic,
                     partitions=kafka_utils.input_topic_partitions(config))
-            self.input_producer = InProcTopicProducer(self.input_broker,
-                                                      self.input_topic)
+            self.input_producer = ResilientTopicProducer(
+                InProcTopicProducer(self.input_broker, self.input_topic),
+                retry=Retry.from_config("serving-input-send", config),
+                breaker=self.input_breaker)
 
         routes = self._discover_routes()
         idle_ms = config.get_int(f"{api}.batch-idle-wait-ms")
@@ -100,6 +112,8 @@ class ServingLayer:
             user_name=self.user_name,
             password=self.password,
             context_path=self.context_path,
+            request_deadline_ms=config.get_int(
+                "oryx.resilience.request-deadline-ms"),
         )
 
     def _discover_routes(self) -> list[Route]:
@@ -152,10 +166,16 @@ class ServingLayer:
         _log.info("Serving layer listening on port %d", self.port)
 
     def _consume_updates(self) -> None:
+        # broker loss mid-tail resubscribes with backoff, replaying the
+        # update topic from offset 0 — recovery IS the cold-start path
+        # (reference: auto.offset.reset=smallest), so the serving model
+        # converges to the same state either way
         broker = resolve_broker(self.update_broker)
-        self.model_manager.consume(
-            broker.consume(self.update_topic, from_beginning=True,
-                           stop=self._stop))
+        run_with_resubscribe(
+            lambda: self.model_manager.consume(
+                broker.consume(self.update_topic, from_beginning=True,
+                               stop=self._stop)),
+            stop=self._stop, what="serving update consumer", log=_log)
 
     def await_(self) -> None:
         while self._server_thread and self._server_thread.is_alive():

@@ -18,6 +18,9 @@ from ..common.lang import load_instance, logging_call
 from ..kafka import utils as kafka_utils
 from ..kafka.api import KEY_UP, KeyMessage
 from ..kafka.inproc import InProcTopicProducer, resolve_broker
+from ..resilience import faults
+from ..resilience.policy import (ResilientTopicProducer, Retry,
+                                 run_with_resubscribe)
 
 _log = logging.getLogger(__name__)
 
@@ -41,7 +44,13 @@ class SpeedLayer:
         self._stop = threading.Event()
         self._consume_thread: threading.Thread | None = None
         self._batch_thread: threading.Thread | None = None
-        self._producer = InProcTopicProducer(self.update_broker, self.update_topic)
+        faults.configure_from_config(config)
+        # a transiently failing UP publish retries with backoff; offsets
+        # advance only after every delta of the micro-batch is published,
+        # so an exhausted retry costs redelivery, never loss
+        self._producer = ResilientTopicProducer(
+            InProcTopicProducer(self.update_broker, self.update_topic),
+            retry=Retry.from_config("speed-publish", config))
 
     def start(self) -> None:
         _log.info("Starting speed layer (micro-batch %ds)",
@@ -77,31 +86,41 @@ class SpeedLayer:
 
     def _consume_updates(self) -> None:
         broker = resolve_broker(self.update_broker)
-        self.model_manager.consume(
-            broker.consume(self.update_topic, from_beginning=True,
-                           stop=self._stop))
+        run_with_resubscribe(
+            lambda: self.model_manager.consume(
+                broker.consume(self.update_topic, from_beginning=True,
+                               stop=self._stop)),
+            stop=self._stop, what="speed update consumer", log=_log)
 
     def _micro_batch_loop(self) -> None:
         broker = resolve_broker(self.input_broker)
-        latest = broker.latest_offsets(self.input_topic)
-        pos = [p if p is not None else latest[i]
-               for i, p in enumerate(
-                   broker.get_offsets(self._group, self.input_topic))]
+        pos = None
         while not self._stop.is_set():
+            if pos is None:
+                try:
+                    latest = broker.latest_offsets(self.input_topic)
+                    pos = [p if p is not None else latest[i]
+                           for i, p in enumerate(broker.get_offsets(
+                               self._group, self.input_topic))]
+                except Exception:  # noqa: BLE001 — broker down at start
+                    _log.exception("Micro-batch position init failed")
+                    self._stop.wait(self.generation_interval_sec)
+                    continue
             self._stop.wait(self.generation_interval_sec)
-            ends = broker.latest_offsets(self.input_topic)
-            if all(e <= p for e, p in zip(ends, pos)):
-                continue
-            new_data = broker.read_ranges(self.input_topic, pos, ends)
             try:
+                ends = broker.latest_offsets(self.input_topic)
+                if all(e <= p for e, p in zip(ends, pos)):
+                    continue
+                new_data = broker.read_ranges(self.input_topic, pos, ends)
                 updates = self.model_manager.build_updates(new_data)
                 for update in updates:
                     self._producer.send(KEY_UP, update)
+                pos = ends
+                broker.set_offsets(self._group, self.input_topic, pos)
             except Exception:  # noqa: BLE001 — micro-batch failure is
                 _log.exception("Micro-batch failed")  # survivable
-                continue
-            pos = ends
-            broker.set_offsets(self._group, self.input_topic, pos)
+                # pos is unchanged unless every delta published, so the
+                # failed batch redelivers in full next interval
 
     def run_one_micro_batch(self) -> None:
         """Synchronously process pending input once (test/ops hook)."""
@@ -113,5 +132,8 @@ class SpeedLayer:
             return
         new_data = broker.read_ranges(self.input_topic, pos, ends)
         for update in self.model_manager.build_updates(new_data):
+            # chaos seam: UP delta publish failure — offsets must not
+            # advance past an unpublished delta
+            faults.fire("speed-publish")
             self._producer.send(KEY_UP, update)
         broker.set_offsets(self._group, self.input_topic, ends)

@@ -1,0 +1,39 @@
+"""Resilience layer: fault injection + retry/deadline/breaker policies.
+
+Two halves, designed together so the second can be *proven* by the
+first (the lineage-recovery argument: recovery code that has never run
+under an injected failure is a claim, not a property — PAPERS.md, the
+Spark Streaming lineage papers; Kafka delivery-semantics design notes):
+
+- :mod:`.faults` — a process-wide registry of named injection points
+  threaded through the kafka transport, the lambda layers, and the
+  artifact store.  Disabled (the default) it is one dict-free boolean
+  check per call site; enabled (programmatically in chaos tests, or via
+  ``oryx.resilience.faults.*`` config) it raises, delays, duplicates
+  or crashes at exactly the seam under test.
+
+- :mod:`.policy` — the generic resilience combinators the runtime uses
+  at those same seams: ``Retry`` (exponential backoff + jitter +
+  deadline), ``Deadline`` propagation from the serving front end into
+  the request micro-batcher, a ``CircuitBreaker`` with half-open
+  probing around broker I/O, and a ``Supervisor`` that restarts crashed
+  layer threads with backoff (deploy/main.py).
+
+Every named policy instance registers itself; ``resilience_snapshot()``
+feeds the serving ``/metrics`` surface.
+"""
+
+from .faults import (FaultSpec, InjectedCrash, InjectedFault,
+                     clear as clear_faults, configure_from_config,
+                     fire, fired, inject)
+from .policy import (Backoff, CircuitBreaker, CircuitOpenError, Deadline,
+                     DeadlineExceeded, ResilientTopicProducer, Retry,
+                     Supervisor, resilience_snapshot)
+
+__all__ = [
+    "FaultSpec", "InjectedCrash", "InjectedFault", "inject", "fire",
+    "fired", "clear_faults", "configure_from_config",
+    "Backoff", "CircuitBreaker", "CircuitOpenError", "Deadline",
+    "DeadlineExceeded", "ResilientTopicProducer", "Retry", "Supervisor",
+    "resilience_snapshot",
+]

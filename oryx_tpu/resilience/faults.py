@@ -1,0 +1,188 @@
+"""Process-wide fault-injection registry.
+
+A call site declares a *named injection point*::
+
+    faults.fire("wire-read", error=lambda: ConnectionError("injected"))
+
+and does nothing else: with no fault registered for that name (the
+production default) ``fire`` is a single module-global boolean check.
+A chaos test (or ``oryx.resilience.faults.*`` config) arms the point::
+
+    faults.inject("wire-read", mode="error", times=1)
+
+after which the next ``times`` calls take the fault action:
+
+========== ==========================================================
+mode       effect at the call site
+========== ==========================================================
+``error``  raise (the point's ``error`` factory, or the spec's, or
+           :class:`InjectedFault`) — a transient, retryable failure
+``crash``  raise :class:`InjectedCrash` — a BaseException, so layer
+           code that survives ``Exception`` dies exactly as if the
+           process were killed at that line
+``delay``  sleep ``delay_sec``, then continue
+``drop``   return ``"drop"`` — the call site discards the operation
+``duplicate`` return ``"duplicate"`` — the call site performs the
+           operation twice (producer-retry duplication)
+========== ==========================================================
+
+``fired(name)`` counts consumed activations, so tests assert the fault
+actually happened rather than trusting that it did.
+
+Point names use dashes (``batch-crash-before-commit``), never dots, so
+they stay addressable as single HOCON keys under
+``oryx.resilience.faults``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable
+
+_log = logging.getLogger(__name__)
+
+__all__ = ["InjectedFault", "InjectedCrash", "FaultSpec", "inject",
+           "clear", "fire", "fired", "configure_from_config"]
+
+
+class InjectedFault(Exception):
+    """A transient injected failure — retryable, like the I/O error it
+    stands in for."""
+
+
+class InjectedCrash(BaseException):
+    """A simulated process kill.  BaseException on purpose: the lambda
+    layers' ``except Exception`` survival handlers must NOT absorb it,
+    exactly as they could not absorb ``kill -9``."""
+
+
+class FaultSpec:
+    __slots__ = ("point", "mode", "remaining", "delay_sec", "error")
+
+    def __init__(self, point: str, mode: str = "error",
+                 times: int | None = 1, delay_sec: float = 0.0,
+                 error: Callable[[], BaseException] | None = None):
+        if mode not in ("error", "crash", "delay", "drop", "duplicate"):
+            raise ValueError(f"unknown fault mode {mode!r}")
+        self.point = point
+        self.mode = mode
+        self.remaining = times  # None = unlimited
+        self.delay_sec = delay_sec
+        self.error = error
+
+
+_LOCK = threading.Lock()
+_SPECS: dict[str, FaultSpec] = {}
+_FIRED: dict[str, int] = {}
+# fast-path flag: fire() must cost one attribute read when no fault is
+# armed anywhere in the process (injection points sit on hot paths)
+_ACTIVE = False
+# configure_from_config arms once per process (see its docstring)
+_CONFIG_APPLIED = False
+
+
+def inject(point: str, mode: str = "error", times: int | None = 1,
+           delay_sec: float = 0.0,
+           error: Callable[[], BaseException] | None = None) -> None:
+    """Arm an injection point (last registration per point wins)."""
+    global _ACTIVE
+    spec = FaultSpec(point, mode=mode, times=times, delay_sec=delay_sec,
+                     error=error)
+    with _LOCK:
+        _SPECS[point] = spec
+        _ACTIVE = True
+    _log.info("Fault armed: %s mode=%s times=%s", point, mode, times)
+
+
+def clear(point: str | None = None) -> None:
+    """Disarm one point, or every point (also resetting fired counters
+    and allowing configure_from_config to arm again)."""
+    global _ACTIVE, _CONFIG_APPLIED
+    with _LOCK:
+        if point is None:
+            _SPECS.clear()
+            _FIRED.clear()
+            _CONFIG_APPLIED = False
+        else:
+            _SPECS.pop(point, None)
+        _ACTIVE = bool(_SPECS)
+
+
+def fired(point: str) -> int:
+    """How many times the point's fault has actually been consumed."""
+    with _LOCK:
+        return _FIRED.get(point, 0)
+
+
+def fire(point: str,
+         error: Callable[[], BaseException] | None = None) -> str | None:
+    """Consume one activation of ``point`` if armed.
+
+    Returns None (no fault), or the mode string for modes the call site
+    implements itself (``drop``/``duplicate``); raises for
+    ``error``/``crash``; sleeps for ``delay``.  ``error`` is the call
+    site's exception factory, letting the raised type match the
+    transport (ConnectionError on a socket, OSError in the store...);
+    a factory on the spec overrides it.
+    """
+    if not _ACTIVE:
+        return None
+    with _LOCK:
+        spec = _SPECS.get(point)
+        if spec is None:
+            return None
+        if spec.remaining is not None:
+            if spec.remaining <= 0:
+                return None
+            spec.remaining -= 1
+        _FIRED[point] = _FIRED.get(point, 0) + 1
+        mode, delay = spec.mode, spec.delay_sec
+        factory = spec.error or error
+    _log.info("Fault fired: %s mode=%s", point, mode)
+    if mode == "delay":
+        time.sleep(delay)
+        return None
+    if mode == "crash":
+        raise InjectedCrash(f"injected crash at {point}")
+    if mode == "error":
+        raise factory() if factory else InjectedFault(
+            f"injected fault at {point}")
+    return mode  # drop / duplicate: the call site acts
+
+
+def configure_from_config(config) -> None:
+    """Arm every fault declared under ``oryx.resilience.faults``.
+
+    Each child is a point name mapping to ``{mode, times, delay-ms}``
+    (``times`` null/absent = 1; ``times = -1`` = unlimited).  An empty
+    ``faults`` block — the shipped default — arms nothing and costs
+    nothing.  Layers call this at construction, so a config file alone
+    can stage a chaos run with no test code.
+
+    Arms at most ONCE per process (until :func:`clear`): a supervised
+    restart reconstructs the layer, and re-arming a finite-``times``
+    crash fault on every incarnation would crash each rebuilt layer at
+    the same seam until the restart budget dies — the opposite of what
+    a staged one-shot fault means.
+    """
+    global _CONFIG_APPLIED
+    try:
+        node = config.get("oryx.resilience.faults")
+    except KeyError:
+        return
+    if not isinstance(node, dict) or not node:
+        return
+    with _LOCK:
+        if _CONFIG_APPLIED:
+            return
+        _CONFIG_APPLIED = True
+    for point, spec in node.items():
+        if not isinstance(spec, dict):
+            continue
+        times = spec.get("times", 1)
+        inject(point,
+               mode=str(spec.get("mode", "error")),
+               times=None if times in (None, -1) else int(times),
+               delay_sec=float(spec.get("delay-ms", 0)) / 1000.0)

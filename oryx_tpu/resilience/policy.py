@@ -1,0 +1,476 @@
+"""Resilience policies: retry/backoff, deadlines, circuit breaker,
+supervised restart.
+
+These are the generic combinators the runtime threads through its
+broker I/O, storage, serving and layer-lifecycle seams; the
+fault-injection registry (:mod:`.faults`) exists to prove each of them
+under the failure it guards against (tests/test_resilience_it.py).
+
+Every named :class:`Retry` and :class:`CircuitBreaker` self-registers
+in a process-wide table; :func:`resilience_snapshot` renders their
+counters for the serving ``/metrics`` surface.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+import weakref
+from typing import Any, Callable
+
+from .faults import InjectedFault
+
+_log = logging.getLogger(__name__)
+
+__all__ = [
+    "DeadlineExceeded", "CircuitOpenError", "Deadline", "Backoff",
+    "Retry", "CircuitBreaker", "Supervisor", "ResilientTopicProducer",
+    "resilience_snapshot", "run_with_resubscribe",
+]
+
+
+def run_with_resubscribe(fn: Callable[[], Any], stop: "threading.Event",
+                         what: str, backoff: "Backoff | None" = None,
+                         log: logging.Logger | None = None) -> None:
+    """Run a blocking subscription (``fn`` returns only on clean end)
+    until it completes or ``stop`` is set, restarting it with backoff
+    on failure.
+
+    The shared shape of the speed/serving update-topic consumers: a
+    broker failure mid-tail must not freeze model state for the life of
+    the process, and since their state build is a full replay from
+    offset 0, recovery IS the cold-start path — the same proven code."""
+    backoff = backoff or Backoff(initial=0.1, maximum=5.0)
+    log = log or _log
+    attempt = 0
+    while not stop.is_set():
+        try:
+            fn()
+            return  # clean end: stop was requested
+        except Exception:  # noqa: BLE001 — resubscribe, don't die
+            attempt += 1
+            log.exception("%s failed; resubscribing (attempt %d)",
+                          what, attempt)
+            stop.wait(backoff.delay(attempt))
+
+
+class DeadlineExceeded(Exception):
+    """A per-call deadline expired before the work completed (mapped to
+    HTTP 503 at the serving surface)."""
+
+
+class CircuitOpenError(Exception):
+    """Fast-fail: the guarded dependency is presumed down and the
+    breaker is shedding calls instead of queueing them."""
+
+
+# -- named-instance registry (the /metrics feed) -----------------------------
+
+_REGISTRY: "weakref.WeakValueDictionary[str, Any]" = \
+    weakref.WeakValueDictionary()
+_REGISTRY_LOCK = threading.Lock()
+
+
+def _register(name: str, instance) -> None:
+    with _REGISTRY_LOCK:
+        _REGISTRY[name] = instance
+
+
+def resilience_snapshot() -> dict:
+    """{name: stats} for every live named Retry / CircuitBreaker."""
+    with _REGISTRY_LOCK:
+        items = list(_REGISTRY.items())
+    return {name: inst.stats() for name, inst in sorted(items)}
+
+
+# -- deadlines ---------------------------------------------------------------
+
+class Deadline:
+    """A monotonic-clock deadline carried from the serving front end
+    down through the request micro-batcher: work that cannot finish in
+    time is refused up front (503) instead of queueing to die."""
+
+    __slots__ = ("t_end",)
+
+    def __init__(self, t_end: float):
+        self.t_end = t_end
+
+    @classmethod
+    def after(cls, seconds: float) -> "Deadline":
+        return cls(time.monotonic() + seconds)
+
+    @property
+    def expired(self) -> bool:
+        return time.monotonic() >= self.t_end
+
+    def remaining(self) -> float:
+        return max(0.0, self.t_end - time.monotonic())
+
+    def check(self, what: str = "call") -> None:
+        if self.expired:
+            raise DeadlineExceeded(f"deadline exceeded in {what}")
+
+    @staticmethod
+    def tightest(*deadlines: "Deadline | None") -> "Deadline | None":
+        live = [d for d in deadlines if d is not None]
+        if not live:
+            return None
+        return min(live, key=lambda d: d.t_end)
+
+
+# -- backoff -----------------------------------------------------------------
+
+class Backoff:
+    """Exponential backoff with full jitter and a cap.
+
+    ``delay(attempt)`` for attempt 1, 2, ... — deterministic when
+    ``jitter=0`` (chaos tests pin it to assert schedules)."""
+
+    __slots__ = ("initial", "maximum", "multiplier", "jitter", "_rng")
+
+    def __init__(self, initial: float = 0.05, maximum: float = 2.0,
+                 multiplier: float = 2.0, jitter: float = 0.2,
+                 rng: random.Random | None = None):
+        self.initial = initial
+        self.maximum = maximum
+        self.multiplier = multiplier
+        self.jitter = jitter
+        self._rng = rng or random.Random()
+
+    def delay(self, attempt: int) -> float:
+        base = min(self.maximum,
+                   self.initial * self.multiplier ** max(0, attempt - 1))
+        if not self.jitter:
+            return base
+        # full jitter on the top `jitter` fraction: retries from many
+        # threads decorrelate instead of thundering back together
+        return base * (1.0 - self.jitter * self._rng.random())
+
+    @classmethod
+    def from_config(cls, config, path: str = "oryx.resilience.retry"
+                    ) -> "Backoff":
+        return cls(
+            initial=config.get_int(f"{path}.initial-backoff-ms") / 1000.0,
+            maximum=config.get_int(f"{path}.max-backoff-ms") / 1000.0,
+            multiplier=config.get_double(f"{path}.multiplier"),
+            jitter=config.get_double(f"{path}.jitter"))
+
+
+# -- retry -------------------------------------------------------------------
+
+class Retry:
+    """Bounded retry of transient failures with backoff.
+
+    ``retryable`` is an exception tuple or a predicate; anything else
+    propagates immediately.  An optional :class:`Deadline` bounds the
+    whole call including sleeps — on expiry the last failure is
+    re-raised rather than swallowed into a DeadlineExceeded."""
+
+    def __init__(self, name: str,
+                 retryable: tuple | Callable[[BaseException], bool]
+                 = (ConnectionError, OSError, TimeoutError,
+                    InjectedFault),
+                 max_attempts: int = 5,
+                 backoff: Backoff | None = None,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.name = name
+        self._retryable = retryable
+        self.max_attempts = max(1, max_attempts)
+        self.backoff = backoff or Backoff()
+        self._sleep = sleep
+        self._lock = threading.Lock()
+        self.calls = 0
+        self.retries = 0
+        self.give_ups = 0
+        _register(name, self)
+
+    @classmethod
+    def from_config(cls, name: str, config, retryable=None) -> "Retry":
+        kw = {} if retryable is None else {"retryable": retryable}
+        return cls(name,
+                   max_attempts=config.get_int(
+                       "oryx.resilience.retry.max-attempts"),
+                   backoff=Backoff.from_config(config), **kw)
+
+    def _is_retryable(self, e: BaseException) -> bool:
+        r = self._retryable
+        # exception classes are callable too: a bare `retryable=OSError`
+        # must mean isinstance, not predicate (calling it would build an
+        # exception object — truthy for EVERY error)
+        if isinstance(r, tuple) or (isinstance(r, type)
+                                    and issubclass(r, BaseException)):
+            return isinstance(e, r)
+        return bool(r(e))
+
+    def call(self, fn: Callable, *args,
+             deadline: Deadline | None = None, **kwargs):
+        with self._lock:
+            self.calls += 1
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                return fn(*args, **kwargs)
+            except Exception as e:  # noqa: BLE001 — classified below
+                if not self._is_retryable(e) or attempt >= self.max_attempts:
+                    with self._lock:
+                        self.give_ups += 1
+                    raise
+                pause = self.backoff.delay(attempt)
+                if deadline is not None \
+                        and deadline.remaining() <= pause:
+                    with self._lock:
+                        self.give_ups += 1
+                    raise  # no time left to retry: surface the cause
+                with self._lock:
+                    self.retries += 1
+                _log.debug("%s: retrying after %s (attempt %d/%d)",
+                           self.name, e, attempt, self.max_attempts)
+                self._sleep(pause)
+
+    def __call__(self, fn: Callable) -> Callable:
+        """Decorator form."""
+        def wrapped(*args, **kwargs):
+            return self.call(fn, *args, **kwargs)
+        wrapped.__name__ = getattr(fn, "__name__", "wrapped")
+        return wrapped
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"kind": "retry", "calls": self.calls,
+                    "retries": self.retries, "give_ups": self.give_ups,
+                    "max_attempts": self.max_attempts}
+
+
+# -- circuit breaker ---------------------------------------------------------
+
+class CircuitBreaker:
+    """Closed -> open after ``failure_threshold`` consecutive failures;
+    open sheds calls (CircuitOpenError) for ``reset_timeout_sec``; then
+    half-open admits ``half_open_probes`` probe calls — success closes,
+    failure re-opens.  ``clock`` is injectable so chaos tests control
+    time instead of sleeping through it."""
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+    def __init__(self, name: str, failure_threshold: int = 5,
+                 reset_timeout_sec: float = 1.0,
+                 half_open_probes: int = 1,
+                 clock: Callable[[], float] = time.monotonic):
+        self.name = name
+        self.failure_threshold = max(1, failure_threshold)
+        self.reset_timeout_sec = reset_timeout_sec
+        self.half_open_probes = max(1, half_open_probes)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = self.CLOSED
+        self._failures = 0
+        self._opened_at = 0.0
+        self._probes_in_flight = 0
+        self.opens = 0
+        self.rejected = 0
+        self.calls = 0
+        _register(name, self)
+
+    @classmethod
+    def from_config(cls, name: str, config,
+                    path: str = "oryx.resilience.breaker"
+                    ) -> "CircuitBreaker":
+        return cls(
+            name,
+            failure_threshold=config.get_int(f"{path}.failure-threshold"),
+            reset_timeout_sec=config.get_int(
+                f"{path}.reset-timeout-ms") / 1000.0,
+            half_open_probes=config.get_int(f"{path}.half-open-probes"))
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def _admit(self) -> bool:
+        """Reserve the right to make one call; False = shed it."""
+        with self._lock:
+            self.calls += 1
+            if self._state == self.CLOSED:
+                return True
+            if self._state == self.OPEN:
+                if (self._clock() - self._opened_at
+                        < self.reset_timeout_sec):
+                    self.rejected += 1
+                    return False
+                self._state = self.HALF_OPEN
+                self._probes_in_flight = 0
+            # half-open: admit a bounded number of concurrent probes
+            if self._probes_in_flight >= self.half_open_probes:
+                self.rejected += 1
+                return False
+            self._probes_in_flight += 1
+            return True
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            if self._state == self.HALF_OPEN:
+                self._state = self.CLOSED
+                _log.info("%s: circuit closed (probe succeeded)",
+                          self.name)
+            self._probes_in_flight = 0
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._failures += 1
+            if self._state == self.HALF_OPEN \
+                    or self._failures >= self.failure_threshold:
+                if self._state != self.OPEN:
+                    self.opens += 1
+                    _log.warning("%s: circuit OPEN after %d failure(s)",
+                                 self.name, self._failures)
+                self._state = self.OPEN
+                self._opened_at = self._clock()
+                self._probes_in_flight = 0
+
+    def call(self, fn: Callable, *args, **kwargs):
+        if not self._admit():
+            raise CircuitOpenError(
+                f"{self.name}: circuit open, call shed")
+        try:
+            out = fn(*args, **kwargs)
+        except BaseException:
+            # BaseException included: an InjectedCrash (or thread kill)
+            # during a half-open probe must release the probe slot, or
+            # _probes_in_flight stays pinned and the breaker sheds
+            # every later call forever
+            self.record_failure()
+            raise
+        self.record_success()
+        return out
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"kind": "breaker", "state": self._state,
+                    "consecutive_failures": self._failures,
+                    "opens": self.opens, "rejected": self.rejected,
+                    "calls": self.calls}
+
+
+# -- supervised restart ------------------------------------------------------
+
+class Supervisor:
+    """Restart-with-backoff around a layer's start/await_/close
+    lifecycle (deploy/main.py).
+
+    The layers' worker threads deliberately survive ``Exception`` but
+    die on anything harsher (an injected crash, a real bug escaping the
+    survival handlers); ``await_`` returning while ``close`` was never
+    requested IS the crash signal.  The supervisor rebuilds the layer
+    from its factory and restarts, with backoff, up to
+    ``max_restarts`` times."""
+
+    def __init__(self, factory: Callable[[], Any], name: str = "layer",
+                 max_restarts: int = 5, backoff: Backoff | None = None,
+                 sleep: Callable[[float], None] = time.sleep,
+                 healthy_reset_sec: float = 300.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.factory = factory
+        self.name = name
+        self.max_restarts = max_restarts
+        self.backoff = backoff or Backoff(initial=0.2, maximum=5.0)
+        self._sleep = sleep
+        self._stop = threading.Event()
+        self.restarts = 0
+        self.layer = None
+        # a layer that stayed up this long earns its restart budget
+        # back: the cap bounds crash LOOPS, not lifetime crash count
+        self.healthy_reset_sec = healthy_reset_sec
+        self._clock = clock
+
+    @classmethod
+    def from_config(cls, factory, name: str, config) -> "Supervisor":
+        path = "oryx.resilience.supervisor"
+        return cls(factory, name=name,
+                   max_restarts=config.get_int(f"{path}.max-restarts"),
+                   backoff=Backoff(
+                       initial=config.get_int(
+                           f"{path}.initial-backoff-ms") / 1000.0,
+                       maximum=config.get_int(
+                           f"{path}.max-backoff-ms") / 1000.0))
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def run(self) -> None:
+        """Blocks until the layer exits cleanly (stop requested /
+        KeyboardInterrupt) or the restart budget is exhausted."""
+        while not self._stop.is_set():
+            started = self._clock()
+            self.layer = None  # a failed factory() must not re-close
+            try:               # the previous, already-closed layer
+                # factory()/start() are INSIDE the try: a rebuild
+                # against a still-down dependency (broker gone, port
+                # not yet released) must count as a crash and retry
+                # with backoff, not kill the process
+                self.layer = self.factory()
+                self.layer.start()
+                self.layer.await_()
+            except KeyboardInterrupt:
+                self._stop.set()
+            except Exception:  # noqa: BLE001 — a failed (re)build is a
+                _log.exception("%s: layer failed", self.name)  # crash
+            finally:
+                if self.layer is not None:
+                    try:
+                        self.layer.close()
+                    except Exception:  # noqa: BLE001 — best-effort
+                        _log.exception("%s: close() failed", self.name)
+            if self._stop.is_set():
+                return
+            if self._clock() - started >= self.healthy_reset_sec:
+                self.restarts = 0
+            if self.restarts >= self.max_restarts:
+                _log.error("%s: gave up after %d restart(s)", self.name,
+                           self.restarts)
+                raise RuntimeError(
+                    f"{self.name}: exceeded {self.max_restarts} restarts")
+            self.restarts += 1
+            pause = self.backoff.delay(self.restarts)
+            _log.warning("%s: layer died; restart %d/%d in %.2fs",
+                         self.name, self.restarts, self.max_restarts,
+                         pause)
+            self._sleep(pause)
+
+
+# -- producer wrapper --------------------------------------------------------
+
+class ResilientTopicProducer:
+    """Retry + circuit breaker around any TopicProducer.
+
+    Breaker outside retry: one exhausted retry sequence counts as ONE
+    breaker failure, so the threshold measures sustained outage, not
+    attempt noise.  With the breaker open, sends shed immediately
+    (CircuitOpenError) — the serving tier maps that to 503 and the
+    half-open probe restores service without a restart."""
+
+    def __init__(self, inner, retry: Retry,
+                 breaker: CircuitBreaker | None = None):
+        self._inner = inner
+        self._retry = retry
+        self._breaker = breaker
+
+    def send(self, key: str | None, message: str) -> None:
+        if self._breaker is None:
+            self._retry.call(self._inner.send, key, message)
+        else:
+            self._breaker.call(self._retry.call, self._inner.send, key,
+                               message)
+
+    def get_update_broker(self) -> str:
+        return self._inner.get_update_broker()
+
+    def get_topic(self) -> str:
+        return self._inner.get_topic()
+
+    def close(self) -> None:
+        self._inner.close()

@@ -145,7 +145,12 @@ def _dot_top_n(req: Request, model: ALSServingModel, how_many: int,
     (ALSServingModel.top_n_batch)."""
     batcher = req.context.get("top_n_batcher")
     if batcher is not None and rescorer is None:
-        return batcher.top_n(model, how_many, user_vector, exclude)
+        # the front-end deadline rides into the batcher queue: expired
+        # work is shed as 503 instead of occupying a device dispatch
+        return batcher.top_n(model, how_many, user_vector, exclude,
+                             deadline=req.deadline)
+    if req.deadline is not None:
+        req.deadline.check("top_n")
     return model.top_n(how_many, user_vector=user_vector, exclude=exclude,
                        rescorer=rescorer)
 

@@ -33,6 +33,8 @@ from typing import Iterable
 
 import numpy as np
 
+from ..resilience.policy import Deadline, DeadlineExceeded
+
 __all__ = ["TopNBatcher"]
 
 # exec-time EWMA clamps: below 0.5 ms pacing is irrelevant; above this
@@ -44,10 +46,10 @@ _MAX_EXEC_S = 5.0
 
 class _Job:
     __slots__ = ("model", "how_many", "vector", "exclude", "done",
-                 "result", "error", "t_enq")
+                 "result", "error", "t_enq", "deadline")
 
     def __init__(self, model, how_many: int, vector: np.ndarray,
-                 exclude: set[str]):
+                 exclude: set[str], deadline: Deadline | None = None):
         self.model = model
         self.how_many = how_many
         self.vector = vector
@@ -56,6 +58,7 @@ class _Job:
         self.result: list[tuple[str, float]] | None = None
         self.error: BaseException | None = None
         self.t_enq = time.monotonic()
+        self.deadline = deadline
 
 
 class TopNBatcher:
@@ -105,15 +108,31 @@ class TopNBatcher:
         # drain-size histogram, exposed for tests and the metrics surface
         self.batch_sizes: list[int] = []
         self.total_dispatches = 0
+        # deadline sheds: refused at submit or expired while queued
+        self.deadline_rejects = 0
 
     def top_n(self, model, how_many: int, user_vector: np.ndarray,
-              exclude: Iterable[str] = ()) -> list[tuple[str, float]]:
+              exclude: Iterable[str] = (),
+              deadline: Deadline | None = None) -> list[tuple[str, float]]:
         """Blocking submit; returns the same pairs as ``model.top_n``
         (dot-product scores; on an LSH-configured model the batched
         dispatch applies the same Hamming-ball candidate mask the
-        single-request path would)."""
+        single-request path would).
+
+        A ``deadline`` (resilience.policy.Deadline, minted at the HTTP
+        front end) is enforced at the two queueing edges: an already-
+        expired request is refused before it queues, and a request whose
+        budget runs out while waiting is shed at dispatch instead of
+        spending device time on an answer nobody is waiting for.  Both
+        raise DeadlineExceeded (503 at the serving surface)."""
+        if deadline is not None and deadline.expired:
+            with self._cond:
+                self.deadline_rejects += 1
+            raise DeadlineExceeded("request deadline expired before "
+                                   "scoring was queued")
         job = _Job(model, how_many,
-                   np.asarray(user_vector, dtype=np.float32), set(exclude))
+                   np.asarray(user_vector, dtype=np.float32), set(exclude),
+                   deadline=deadline)
         with self._cond:
             if self._stopped:
                 # shutdown race: keep-alive handler threads may outlive
@@ -145,6 +164,7 @@ class TopNBatcher:
                 "in_flight": self._in_flight,
                 "in_flight_target": self._in_flight_target(),
                 "pending": len(self._pending),
+                "deadline_rejects": self.deadline_rejects,
             }
 
     def close(self) -> None:
@@ -220,13 +240,22 @@ class TopNBatcher:
                     self._in_flight += 1
                     self._last_dispatch = time.monotonic()
                 stopped = self._stopped
+            scored = 0
             if jobs:
                 t0 = time.monotonic()
-                self._dispatch(jobs)
+                scored = self._dispatch(jobs)
                 wall = time.monotonic() - t0
             if not stopped:
                 with self._cond:
                     self._in_flight -= 1
+                    if not scored:
+                        # every job was deadline-shed: no device call
+                        # happened, and folding the near-zero wall into
+                        # the estimators would collapse _wall_min /
+                        # _exec_ewma and disable coalescing long after
+                        # the deadline burst ends
+                        self._cond.notify(2)
+                        continue
                     now = time.monotonic()
                     # decay toward recent walls so a transient stall
                     # (compile, GC) cannot pin the round-trip estimate
@@ -255,7 +284,22 @@ class TopNBatcher:
             if stopped:
                 return
 
-    def _dispatch(self, jobs: list[_Job]) -> None:
+    def _dispatch(self, jobs: list[_Job]) -> int:
+        """Score a drained batch; returns how many jobs actually reached
+        the device (0 = all shed, caller must not learn pacing from it)."""
+        # shed jobs whose budget expired while queued: their client has
+        # already given up, and scoring them would tax every live job in
+        # the same drain with their share of the device time
+        expired = [j for j in jobs
+                   if j.deadline is not None and j.deadline.expired]
+        if expired:
+            with self._cond:
+                self.deadline_rejects += len(expired)
+            for j in expired:
+                j.error = DeadlineExceeded(
+                    "request deadline expired while queued")
+                j.done.set()
+            jobs = [j for j in jobs if j.error is None]
         by_model: dict[int, list[_Job]] = {}
         for j in jobs:
             by_model.setdefault(id(j.model), []).append(j)
@@ -280,3 +324,4 @@ class TopNBatcher:
                     del self.batch_sizes[:5000]
             for j in group:
                 j.done.set()
+        return len(jobs)

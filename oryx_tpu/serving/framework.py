@@ -15,6 +15,7 @@ from typing import Any
 from ..api.serving import OryxServingException
 from ..lambda_rt.http import (HtmlResponse, Request, Route, TextResponse,
                               render_error_page)
+from ..resilience.policy import CircuitOpenError, resilience_snapshot
 
 __all__ = ["ROUTES", "get_serving_model", "send_input"]
 
@@ -38,7 +39,16 @@ def send_input(req: Request, line: str) -> None:
     # key = hash of the message, so identical records land in the same
     # partition (reference: AbstractOryxResource.sendInput :68 sends
     # Integer.toHexString(message.hashCode()) as the key)
-    producer.send(format(zlib.crc32(line.encode("utf-8")), "x"), line)
+    try:
+        producer.send(format(zlib.crc32(line.encode("utf-8")), "x"), line)
+    except CircuitOpenError as e:
+        # broker presumed down: degrade the write surface to fast 503s
+        # (not 500 — the request was fine; the dependency is not) and
+        # let the breaker's half-open probe restore it without restart
+        raise OryxServingException(503, f"input unavailable: {e}") from e
+    except Exception as e:  # noqa: BLE001 — any broker fault degrades,
+        raise OryxServingException(                   # it doesn't error
+            503, f"input send failed: {e}") from e
 
 
 def _ready(req: Request):
@@ -87,6 +97,9 @@ def _metrics(req: Request):
     batcher = req.context.get("top_n_batcher")
     if batcher is not None:
         out["scoring_batcher"] = batcher.stats()
+    # named retry / circuit-breaker counters (resilience.policy) — the
+    # evidence surface for "is the breaker open, how often do we retry"
+    out["resilience"] = resilience_snapshot()
     # app-agnostic hook: a serving model may contribute its own gauges
     # (e.g. the ALS model's streaming top-k fallback counter)
     app_metrics = getattr(model, "metrics", None)

@@ -1116,3 +1116,19 @@ def test_fold_pallas_interpret_agrees_with_scan_kernel():
             np.testing.assert_array_equal(cert_f, cert_s)
     finally:
         sm._PA_TILE = old_tile
+
+
+def test_int8_selection_bool_normalizes_to_explicit_opt_in():
+    """ADVICE r05 #1: a programmatic int8_selection=True (bool, allowed
+    by the `str | bool` signature) must get the same explicit-opt-in
+    precedence as the string "true" — the dispatch chain orders kinds
+    by comparing against canonical strings."""
+    model = ALSServingModel(features=6, implicit=True,
+                            int8_selection=True)
+    assert model._int8_selection == "true"
+    assert model._int8_enabled()
+    # False normalizes to the canonical off string, not bool identity
+    off = ALSServingModel(features=6, implicit=True,
+                          int8_selection=False)
+    assert off._int8_selection == "false"
+    assert not off._int8_enabled()

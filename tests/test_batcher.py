@@ -293,3 +293,132 @@ def test_metrics_surface_exposes_batcher_and_fallback_state():
         assert m["model_metrics"]["items"] == 30
     finally:
         layer.close()
+
+
+def test_close_submit_race_degrades_to_unbatched():
+    """Shutdown race (batcher.top_n's stopped branch): keep-alive
+    handler threads outliving close() must get a correct unbatched
+    answer, never a 500."""
+    model = _small_model()
+    batcher = TopNBatcher(pipeline=2)
+    batcher.close()
+    vec = model.get_user_vector("u0")
+    got = batcher.top_n(model, 4, vec, exclude={"i1"})
+    want = model.top_n(4, user_vector=vec, exclude={"i1"})
+    assert [i for i, _ in got] == [i for i, _ in want]
+
+
+def test_concurrent_close_and_submit_never_errors():
+    """Hammer submits from many threads while close() lands mid-stream:
+    every request must complete correctly through either the batched or
+    the degraded path."""
+    model = _small_model()
+    batcher = TopNBatcher(pipeline=4)
+    errors: list[BaseException] = []
+    results: list[int] = []
+    start = threading.Event()
+
+    def worker(uid):
+        vec = model.get_user_vector(uid)
+        start.wait(5.0)
+        for _ in range(20):
+            try:
+                got = batcher.top_n(model, 3, vec)
+                assert len(got) == 3
+                results.append(1)
+            except BaseException as e:  # noqa: BLE001 — recorded
+                errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(f"u{i % 6}",))
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    start.set()
+    # close lands while workers are mid-flight
+    batcher.close()
+    for t in threads:
+        t.join(10.0)
+    assert not errors
+    assert len(results) == 8 * 20
+
+
+def test_deadline_expired_at_submit_is_rejected():
+    from oryx_tpu.resilience.policy import Deadline, DeadlineExceeded
+
+    model = _small_model()
+    batcher = TopNBatcher()
+    try:
+        with pytest.raises(DeadlineExceeded):
+            batcher.top_n(model, 3, model.get_user_vector("u0"),
+                          deadline=Deadline.after(0.0))
+        assert batcher.stats()["deadline_rejects"] == 1
+        # an ample deadline is untouched
+        got = batcher.top_n(model, 3, model.get_user_vector("u0"),
+                            deadline=Deadline.after(30.0))
+        assert len(got) == 3
+    finally:
+        batcher.close()
+
+
+def test_deadline_expiring_while_queued_is_shed_at_dispatch():
+    """A job whose budget runs out while it waits behind a stalled
+    dispatch is shed (DeadlineExceeded) instead of being scored."""
+    from oryx_tpu.resilience.policy import Deadline, DeadlineExceeded
+
+    model = _small_model()
+    in_dispatch = threading.Event()
+    release = threading.Event()
+
+    class GatedModel:
+        def __init__(self, inner):
+            self._inner = inner
+            self._first = True
+
+        def top_n_batch(self, how_many, vectors, exclude):
+            if self._first:
+                self._first = False
+                in_dispatch.set()
+                release.wait(10.0)
+            return self._inner.top_n_batch(how_many, vectors, exclude)
+
+    gated = GatedModel(model)
+    batcher = TopNBatcher(pipeline=1)
+    outcome: dict = {}
+
+    def stalled_submit():
+        outcome["first"] = batcher.top_n(
+            gated, 3, model.get_user_vector("u0"))
+
+    def doomed_submit():
+        deadline = Deadline.after(0.05)
+        try:
+            batcher.top_n(gated, 3, model.get_user_vector("u1"),
+                          deadline=deadline)
+            outcome["second"] = "scored"
+        except DeadlineExceeded:
+            outcome["second"] = "shed"
+
+    try:
+        first = threading.Thread(target=stalled_submit)
+        first.start()
+        assert in_dispatch.wait(5.0)
+        # valid at submit, expired by the time the drain dispatches
+        second = threading.Thread(target=doomed_submit)
+        second.start()
+        deadline = time.monotonic() + 5.0
+        while not batcher._pending and time.monotonic() < deadline:
+            time.sleep(0.002)
+        # hold the gate until the queued job's budget is provably gone
+        expiry = time.monotonic() + 0.06
+        while time.monotonic() < expiry:
+            time.sleep(0.005)
+        release.set()
+        first.join(5.0)
+        second.join(5.0)
+    finally:
+        release.set()
+        batcher.close()
+
+    assert len(outcome["first"]) == 3
+    assert outcome["second"] == "shed"
+    assert batcher.deadline_rejects >= 1

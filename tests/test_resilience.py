@@ -1,0 +1,440 @@
+"""Resilience policy + fault-registry unit tests (oryx_tpu/resilience/):
+retry/backoff/deadline semantics, circuit-breaker state machine with an
+injected clock, supervisor restart accounting with an injected sleep,
+and the fault registry's arm/fire/times/config contract."""
+
+import threading
+import time
+
+import pytest
+
+from oryx_tpu.common.config import from_dict
+from oryx_tpu.resilience import faults
+from oryx_tpu.resilience.policy import (Backoff, CircuitBreaker,
+                                        CircuitOpenError, Deadline,
+                                        DeadlineExceeded, Retry,
+                                        Supervisor, resilience_snapshot)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# -- backoff -----------------------------------------------------------------
+
+def test_backoff_schedule_is_exponential_and_capped():
+    b = Backoff(initial=0.1, maximum=0.5, multiplier=2.0, jitter=0.0)
+    assert [b.delay(a) for a in (1, 2, 3, 4, 5)] == \
+        [0.1, 0.2, 0.4, 0.5, 0.5]
+
+
+def test_backoff_jitter_only_shrinks():
+    b = Backoff(initial=0.1, maximum=1.0, multiplier=2.0, jitter=0.5)
+    for attempt in range(1, 6):
+        base = Backoff(initial=0.1, maximum=1.0, multiplier=2.0,
+                       jitter=0.0).delay(attempt)
+        for _ in range(20):
+            d = b.delay(attempt)
+            assert base * 0.5 <= d <= base
+
+
+# -- retry -------------------------------------------------------------------
+
+def _fail_n_times(n, exc=ConnectionError):
+    state = {"calls": 0}
+
+    def fn():
+        state["calls"] += 1
+        if state["calls"] <= n:
+            raise exc(f"failure {state['calls']}")
+        return "ok"
+
+    return fn, state
+
+
+def test_retry_succeeds_after_transient_failures():
+    r = Retry("t-retry-1", max_attempts=4,
+              backoff=Backoff(0.001, 0.002, jitter=0.0))
+    fn, state = _fail_n_times(2)
+    assert r.call(fn) == "ok"
+    assert state["calls"] == 3
+    s = r.stats()
+    assert s["retries"] == 2 and s["give_ups"] == 0
+
+
+def test_retry_gives_up_after_max_attempts():
+    r = Retry("t-retry-2", max_attempts=3,
+              backoff=Backoff(0.001, 0.002, jitter=0.0))
+    fn, state = _fail_n_times(99)
+    with pytest.raises(ConnectionError):
+        r.call(fn)
+    assert state["calls"] == 3
+    assert r.stats()["give_ups"] == 1
+
+
+def test_retry_does_not_retry_nonretryable():
+    r = Retry("t-retry-3", retryable=(ConnectionError,), max_attempts=5,
+              backoff=Backoff(0.001, 0.002, jitter=0.0))
+    fn, state = _fail_n_times(99, exc=ValueError)
+    with pytest.raises(ValueError):
+        r.call(fn)
+    assert state["calls"] == 1  # surfaced immediately
+
+
+def test_retry_predicate_form():
+    r = Retry("t-retry-4",
+              retryable=lambda e: "soft" in str(e), max_attempts=3,
+              backoff=Backoff(0.001, 0.002, jitter=0.0))
+    fn, state = _fail_n_times(1, exc=lambda m: RuntimeError(f"soft {m}"))
+    assert r.call(fn) == "ok"
+    assert state["calls"] == 2
+
+
+def test_retry_respects_deadline():
+    # backoff pause (10 ms) exceeds the remaining budget: the retry
+    # gives up and re-raises the CAUSE, not a DeadlineExceeded
+    r = Retry("t-retry-5", max_attempts=10,
+              backoff=Backoff(0.010, 0.010, jitter=0.0))
+    fn, state = _fail_n_times(99)
+    with pytest.raises(ConnectionError):
+        r.call(fn, deadline=Deadline.after(0.001))
+    assert state["calls"] == 1
+
+
+def test_retry_retries_injected_faults_by_default():
+    r = Retry("t-retry-6", max_attempts=3,
+              backoff=Backoff(0.001, 0.002, jitter=0.0))
+    fn, state = _fail_n_times(1, exc=faults.InjectedFault)
+    assert r.call(fn) == "ok"
+
+
+# -- deadline ----------------------------------------------------------------
+
+def test_deadline_expiry_and_check():
+    d = Deadline.after(60.0)
+    assert not d.expired and d.remaining() > 0
+    d.check("anything")  # no raise
+    expired = Deadline.after(0.0)
+    assert expired.expired and expired.remaining() == 0.0
+    with pytest.raises(DeadlineExceeded):
+        expired.check("work")
+
+
+def test_deadline_tightest():
+    a, b = Deadline.after(10.0), Deadline.after(1.0)
+    assert Deadline.tightest(a, b) is b
+    assert Deadline.tightest(a, None) is a
+    assert Deadline.tightest(None, None) is None
+
+
+# -- circuit breaker ---------------------------------------------------------
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _boom():
+    raise ConnectionError("down")
+
+
+def test_breaker_opens_sheds_probes_and_closes():
+    clock = _Clock()
+    cb = CircuitBreaker("t-breaker-1", failure_threshold=2,
+                        reset_timeout_sec=5.0, clock=clock)
+    for _ in range(2):
+        with pytest.raises(ConnectionError):
+            cb.call(_boom)
+    assert cb.state == CircuitBreaker.OPEN
+    # open: calls shed without touching the dependency
+    with pytest.raises(CircuitOpenError):
+        cb.call(lambda: "never runs")
+    # before the reset timeout the circuit stays open
+    clock.t = 4.9
+    with pytest.raises(CircuitOpenError):
+        cb.call(lambda: "still shed")
+    # after the timeout one probe is admitted; success closes
+    clock.t = 5.1
+    assert cb.call(lambda: "probe") == "probe"
+    assert cb.state == CircuitBreaker.CLOSED
+    s = cb.stats()
+    assert s["opens"] == 1 and s["rejected"] == 2
+
+
+def test_breaker_failed_probe_reopens():
+    clock = _Clock()
+    cb = CircuitBreaker("t-breaker-2", failure_threshold=1,
+                        reset_timeout_sec=1.0, clock=clock)
+    with pytest.raises(ConnectionError):
+        cb.call(_boom)
+    assert cb.state == CircuitBreaker.OPEN
+    clock.t = 1.5
+    with pytest.raises(ConnectionError):
+        cb.call(_boom)  # half-open probe fails
+    assert cb.state == CircuitBreaker.OPEN
+    # and the reopen restarted the reset clock
+    clock.t = 2.0
+    with pytest.raises(CircuitOpenError):
+        cb.call(lambda: "shed")
+    assert cb.stats()["opens"] == 2
+
+
+def test_breaker_half_open_bounds_concurrent_probes():
+    clock = _Clock()
+    cb = CircuitBreaker("t-breaker-3", failure_threshold=1,
+                        reset_timeout_sec=1.0, half_open_probes=1,
+                        clock=clock)
+    with pytest.raises(ConnectionError):
+        cb.call(_boom)
+    clock.t = 2.0
+    # first probe admitted and held in flight; the second is shed
+    assert cb._admit() is True
+    assert cb._admit() is False
+    cb.record_success()
+    assert cb.state == CircuitBreaker.CLOSED
+
+
+def test_snapshot_carries_named_instances():
+    r = Retry("t-snap-retry", max_attempts=2,
+              backoff=Backoff(0.001, 0.002, jitter=0.0))
+    cb = CircuitBreaker("t-snap-breaker")
+    snap = resilience_snapshot()
+    assert snap["t-snap-retry"]["kind"] == "retry"
+    assert snap["t-snap-breaker"]["state"] == "closed"
+    del r, cb
+
+
+# -- supervisor --------------------------------------------------------------
+
+class _FakeLayer:
+    """await_ returns immediately while `alive` is False (a crashed
+    worker thread); otherwise blocks until close()."""
+
+    def __init__(self, alive: bool):
+        self._alive = alive
+        self._stop = threading.Event()
+        self.closed = False
+
+    def start(self):
+        pass
+
+    def await_(self):
+        if self._alive:
+            self._stop.wait()
+
+    def close(self):
+        self.closed = True
+        self._stop.set()
+
+
+def test_supervisor_restarts_dead_layer_then_runs():
+    created = []
+    sup_holder = {}
+
+    def factory():
+        # first two layers die instantly; the third stays up, and the
+        # test stops the supervisor as if an operator shut it down
+        layer = _FakeLayer(alive=len(created) >= 2)
+        created.append(layer)
+        return layer
+
+    sleeps = []
+    sup = Supervisor(factory, "t-layer", max_restarts=5,
+                     backoff=Backoff(0.01, 0.04, jitter=0.0),
+                     sleep=sleeps.append)
+    sup_holder["sup"] = sup
+
+    runner = threading.Thread(target=sup.run)
+    runner.start()
+    # third layer blocks in await_; stop it like the shutdown hook does
+    deadline = Deadline.after(10.0)
+    while len(created) < 3 and not deadline.expired:
+        time.sleep(0.001)
+    assert len(created) == 3
+    sup.stop()
+    sup.layer.close()
+    runner.join(10.0)
+    assert not runner.is_alive()
+    assert sup.restarts == 2
+    assert sleeps == [0.01, 0.02]  # exponential restart backoff
+    assert all(layer.closed for layer in created)
+
+
+def test_supervisor_gives_up_after_max_restarts():
+    def factory():
+        return _FakeLayer(alive=False)
+
+    sup = Supervisor(factory, "t-layer-2", max_restarts=2,
+                     backoff=Backoff(0.0, 0.0, jitter=0.0),
+                     sleep=lambda s: None)
+    with pytest.raises(RuntimeError, match="exceeded 2 restarts"):
+        sup.run()
+    assert sup.restarts == 2
+
+
+# -- fault registry ----------------------------------------------------------
+
+def test_fault_fire_is_noop_when_unarmed():
+    assert faults.fire("nothing-armed") is None
+    assert faults.fired("nothing-armed") == 0
+
+
+def test_fault_times_bound_and_counter():
+    faults.inject("t-point", mode="error", times=2)
+    for _ in range(2):
+        with pytest.raises(faults.InjectedFault):
+            faults.fire("t-point")
+    assert faults.fire("t-point") is None  # disarmed after 2
+    assert faults.fired("t-point") == 2
+
+
+def test_fault_crash_is_base_exception():
+    faults.inject("t-crash", mode="crash")
+    with pytest.raises(faults.InjectedCrash):
+        try:
+            faults.fire("t-crash")
+        except Exception:  # the layers' survival handlers
+            pytest.fail("InjectedCrash must not be absorbable "
+                        "by `except Exception`")
+
+
+def test_fault_error_factory_matches_transport():
+    faults.inject("t-conn", mode="error")
+    with pytest.raises(ConnectionError):
+        faults.fire("t-conn", error=lambda: ConnectionError("dropped"))
+
+
+def test_fault_drop_and_duplicate_return_mode():
+    faults.inject("t-dup", mode="duplicate", times=1)
+    assert faults.fire("t-dup") == "duplicate"
+    assert faults.fire("t-dup") is None
+    faults.inject("t-drop", mode="drop", times=1)
+    assert faults.fire("t-drop") == "drop"
+
+
+def test_faults_configure_from_config():
+    cfg = from_dict({
+        "oryx.resilience.faults.some-point.mode": "error",
+        "oryx.resilience.faults.some-point.times": 3,
+        "oryx.resilience.faults.other-point.mode": "drop",
+        "oryx.resilience.faults.other-point.times": -1,
+    })
+    faults.configure_from_config(cfg)
+    with pytest.raises(faults.InjectedFault):
+        faults.fire("some-point")
+    assert faults.fire("other-point") == "drop"
+    assert faults.fire("other-point") == "drop"  # -1 = unlimited
+
+
+def test_default_config_arms_nothing():
+    faults.configure_from_config(from_dict({}))
+    assert faults.fire("inproc-send") is None
+
+
+def test_retry_accepts_bare_exception_class():
+    # an exception class is callable: it must be treated as isinstance,
+    # never invoked as a predicate (which would retry EVERY error)
+    r = Retry("t-retry-7", retryable=OSError, max_attempts=3,
+              backoff=Backoff(0.001, 0.002, jitter=0.0))
+    fn, state = _fail_n_times(1, exc=OSError)
+    assert r.call(fn) == "ok"
+    fn2, state2 = _fail_n_times(9, exc=ValueError)
+    with pytest.raises(ValueError):
+        r.call(fn2)
+    assert state2["calls"] == 1
+
+
+def test_supervisor_survives_factory_and_start_failures():
+    # a rebuild against a still-down dependency raises from factory();
+    # that must consume restart budget, not kill the process
+    attempts = []
+
+    def factory():
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise ConnectionError("broker still down")
+        return _FakeLayer(alive=False)
+
+    sup = Supervisor(factory, "t-layer-3", max_restarts=3,
+                     backoff=Backoff(0.0, 0.0, jitter=0.0),
+                     sleep=lambda s: None)
+    with pytest.raises(RuntimeError, match="exceeded 3 restarts"):
+        sup.run()
+    assert len(attempts) == 4  # initial + 3 restarts
+
+
+def test_supervisor_healthy_uptime_resets_restart_budget():
+    clock = _Clock()
+
+    class _TimedLayer(_FakeLayer):
+        def __init__(self):
+            super().__init__(alive=False)
+
+        def await_(self):
+            clock.t += 1000.0  # "ran healthily for a long time"
+
+    sup = Supervisor(_TimedLayer, "t-layer-4", max_restarts=2,
+                     backoff=Backoff(0.0, 0.0, jitter=0.0),
+                     sleep=lambda s: None, healthy_reset_sec=300.0,
+                     clock=clock)
+    # every run exceeds the healthy window, so the budget keeps
+    # resetting; stop it externally after a handful of cycles
+    cycles = []
+    real_sleep = sup._sleep
+
+    def counting_sleep(s):
+        cycles.append(1)
+        if len(cycles) >= 6:
+            sup.stop()
+        real_sleep(s)
+
+    sup._sleep = counting_sleep
+    sup.run()  # would raise after 2 restarts without the reset
+    assert sup.restarts <= 1
+
+
+def test_breaker_releases_probe_slot_on_base_exception():
+    # a crash (BaseException) during the half-open probe must record a
+    # failure and free the probe slot — a leaked slot would shed every
+    # later call forever even after the dependency recovers
+    clock = _Clock()
+    cb = CircuitBreaker("t-breaker-4", failure_threshold=1,
+                        reset_timeout_sec=1.0, clock=clock)
+    with pytest.raises(ConnectionError):
+        cb.call(_boom)
+    clock.t = 2.0
+
+    def crash():
+        raise faults.InjectedCrash("kill during probe")
+
+    with pytest.raises(faults.InjectedCrash):
+        cb.call(crash)
+    assert cb.state == CircuitBreaker.OPEN  # re-opened, not wedged
+    clock.t = 4.0
+    assert cb.call(lambda: "probe") == "probe"
+    assert cb.state == CircuitBreaker.CLOSED
+
+
+def test_config_faults_arm_once_per_process():
+    cfg = from_dict({
+        "oryx.resilience.faults.once-point.mode": "error",
+        "oryx.resilience.faults.once-point.times": 1,
+    })
+    faults.configure_from_config(cfg)
+    with pytest.raises(faults.InjectedFault):
+        faults.fire("once-point")
+    # a supervised-restart rebuild calls configure again: it must NOT
+    # re-arm the consumed one-shot fault
+    faults.configure_from_config(cfg)
+    assert faults.fire("once-point") is None
+    # clear() re-opens the once-slot for the next staged run
+    faults.clear()
+    faults.configure_from_config(cfg)
+    with pytest.raises(faults.InjectedFault):
+        faults.fire("once-point")

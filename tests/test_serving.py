@@ -476,7 +476,10 @@ def test_metrics_endpoint(server):
     _status_of(server, "/recommend/nobody")  # 404 counted as error
     m = _get(server, "/metrics")
     assert set(m) == {"routes", "model_fraction_loaded",
-                      "scoring_batcher", "model_metrics"}
+                      "scoring_batcher", "model_metrics", "resilience"}
+    # every resilience entry is a named retry/breaker counter dict
+    for stats in m["resilience"].values():
+        assert stats["kind"] in ("retry", "breaker")
     rec = m["routes"]["GET /recommend/{userID}"]
     assert rec["count"] >= 4
     assert rec["errors"] >= 1

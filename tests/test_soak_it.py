@@ -108,11 +108,28 @@ def test_three_layers_concurrent_soak(tmp_path):
             t.start()
 
         # under live traffic: a speed micro-batch emits UP deltas and a
-        # fresh batch generation hot-swaps the MODEL
-        time.sleep(1.0)
+        # fresh batch generation hot-swaps the MODEL.  Wait on the
+        # OBSERVABLE condition (u20's pref visible on the input topic),
+        # not a fixed sleep — under a loaded CI box the writer thread
+        # may need longer than any constant to reach u20 (n=20 at one
+        # pref per 10 ms is >= 200 ms of fair scheduling)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            end = broker.latest_offset("SoakIn")
+            if any("u20," in km.message
+                   for km in broker.read_range("SoakIn", 0, end)):
+                break
+            time.sleep(0.05)
         speed.run_one_micro_batch()
         batch.run_one_generation()
-        time.sleep(2.0)
+        # bounded wait for the serving consumer to replay the new MODEL
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            m = serving.model_manager.get_model()
+            if (m is not None and m.get_fraction_loaded() >= 0.8
+                    and "u20" in m.all_user_ids()):
+                break
+            time.sleep(0.05)
 
         stop.set()
         for t in threads:
