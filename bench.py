@@ -140,17 +140,27 @@ def main() -> None:
     # closed-loop measured run only: the open-loop ladder's drains at
     # other offered rates would otherwise dominate the mean
     sizes = batcher.batch_sizes[warm_drains:measured_drains]
+    # HEADLINE = open-loop SUSTAINED qps (VERDICT r5 Next #8): the
+    # highest offered arrival rate (TrafficUtil-style exponential
+    # inter-arrival) the server held without backlog divergence.  The
+    # closed-loop number stays as a secondary column — it is bounded by
+    # workers/RTT through the device tunnel and can overstate what the
+    # server holds under arrival-driven load.
+    headline = open_loop_sustained if open_loop_sustained > 0.0 else qps
     print(json.dumps({
-        "metric": "als_recommend_http_qps_50f_1M_exact",
-        "value": round(qps, 1),
+        "metric": "als_recommend_http_sustained_qps_50f_1M_exact",
+        "value": round(headline, 1),
         "unit": "qps",
-        "vs_baseline": round(qps / BASELINE_QPS, 2),
+        "vs_baseline": round(headline / BASELINE_QPS, 2),
+        "open_loop_sustained_qps": open_loop_sustained,
+        "closed_loop_qps": round(qps, 1),
+        "vs_baseline_closed_loop": round(qps / BASELINE_QPS, 2),
+        "headline_is_closed_loop_fallback": open_loop_sustained <= 0.0,
         "p50_ms": round(stats.percentile_ms(50), 2),
         "p95_ms": round(stats.percentile_ms(95), 2),
         "p99_ms": round(stats.percentile_ms(99), 2),
         "mean_device_batch": round(float(np.mean(sizes)), 1) if sizes else 0,
         "kernel_qps": round(kernel_qps, 1),
-        "open_loop_sustained_qps": open_loop_sustained,
     }))
 
 

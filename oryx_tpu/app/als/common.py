@@ -15,6 +15,7 @@ triple ready for device scatter.
 
 from __future__ import annotations
 
+import logging
 import math
 import time
 from typing import Iterable, NamedTuple, Sequence
@@ -23,11 +24,47 @@ import numpy as np
 
 from ...common import text as text_utils
 from ...kafka.api import KeyMessage
+from ...ml.integrity import is_finite_array
 
 __all__ = ["ParsedRatings", "parse_events", "aggregate", "build_known_items",
-           "decay_value"]
+           "decay_value", "parse_up_update"]
+
+_log = logging.getLogger(__name__)
 
 MS_PER_DAY = 86_400_000.0
+
+
+def parse_up_update(message: str, features: int | None = None
+                    ) -> tuple[str, str, np.ndarray, list | None] | None:
+    """Parse and integrity-check an "UP" factor update payload for the
+    speed/serving consumers: ``["X"|"Y", id, [floats], [known...]?]``.
+
+    Returns ``(kind, id, vector, extras)`` — ``extras`` is the optional
+    4th element (known-item IDs) or None — or **None** when the payload
+    is malformed, the wrong dimension (``features``, when given), or
+    carries non-finite values.  One shared gate so "finite" means the
+    same thing at both consumers: the callers count the rejection and
+    skip, because a raised error inside a replay-from-0 resubscribe
+    loop would turn one poison message into an infinite cycle, and a
+    NaN (or broadcast-mismatched) row absorbed silently would poison
+    every score and Gramian solve it touches."""
+    try:
+        update = text_utils.read_json(message)
+        # KeyError: a JSON *object* payload indexes by key, not position
+        kind, id_ = str(update[0]), str(update[1])
+        vector = np.asarray(update[2], dtype=np.float32)
+        extras = list(update[3]) if len(update) > 3 else None
+    except (ValueError, IndexError, KeyError, TypeError):
+        _log.warning("Rejecting malformed update (%d bytes)", len(message))
+        return None
+    if vector.ndim != 1 \
+            or (features is not None and vector.shape[0] != features) \
+            or not is_finite_array(vector):
+        _log.warning("Rejecting non-finite/malformed %s update for %s "
+                     "(shape %s, expected (%s,))",
+                     kind, id_, vector.shape, features)
+        return None
+    return kind, id_, vector, extras
 
 
 class ParsedRatings(NamedTuple):

@@ -12,15 +12,13 @@ from __future__ import annotations
 
 import logging
 
-import numpy as np
-
 from ...api.serving import AbstractServingModelManager
 from ...common import pmml as pmml_io
-from ...common import text as text_utils
 from ...common.config import Config
 from ...common.lang import RateLimitCheck
 from ...kafka.api import KEY_MODEL, KEY_MODEL_REF, KEY_UP
 from ..pmml_utils import read_pmml_from_update_key_message
+from . import common as als_common
 from .rescorer import load_rescorer_providers
 from .serving_model import ALSServingModel
 
@@ -61,6 +59,10 @@ class ALSServingModelManager(AbstractServingModelManager):
         if not 0.0 < self.sample_rate <= 1.0:
             raise ValueError("sample-rate must be in (0,1]")
         self._log_rate_limit = RateLimitCheck(60.0)
+        # integrity counters: how many poison payloads this consumer
+        # refused instead of absorbing into the serving model
+        self.rejected_updates = 0
+        self.rejected_models = 0
 
     def get_model(self) -> ALSServingModel | None:
         return self.model
@@ -70,13 +72,18 @@ class ALSServingModelManager(AbstractServingModelManager):
             model = self.model
             if model is None:
                 return  # no model to interpret with yet
-            update = text_utils.read_json(message)
-            kind, id_ = str(update[0]), str(update[1])
-            vector = np.asarray(update[2], dtype=np.float32)
+            parsed = als_common.parse_up_update(message, model.features)
+            if parsed is None:
+                # malformed, wrong-dimension, or non-finite payload
+                # refused at the trust boundary (shared gate:
+                # als_common.parse_up_update)
+                self.rejected_updates += 1
+                return
+            kind, id_, vector, extras = parsed
             if kind == "X":
                 model.set_user_vector(id_, vector)
-                if len(update) > 3:
-                    model.add_known_items(id_, [str(i) for i in update[3]])
+                if extras is not None:
+                    model.add_known_items(id_, [str(i) for i in extras])
             elif kind == "Y":
                 model.set_item_vector(id_, vector)
             else:
@@ -92,8 +99,19 @@ class ALSServingModelManager(AbstractServingModelManager):
             _log.info("Loading new model")
             pmml = read_pmml_from_update_key_message(key, message)
             if pmml is None:
+                self.rejected_models += 1
+                _log.warning("Model document unavailable or corrupt; "
+                             "keeping current model")
                 return
-            features = int(pmml_io.get_extension_value(pmml, "features"))
+            try:
+                features = int(pmml_io.get_extension_value(pmml, "features"))
+            except (TypeError, ValueError):
+                # parseable XML that is not a factored-model document
+                # (e.g. recovered from a partially corrupt artifact)
+                self.rejected_models += 1
+                _log.warning("Model document failed validation; keeping "
+                             "current model")
+                return
             implicit = pmml_io.get_extension_value(pmml, "implicit") == "true"
             if self.model is None or features != self.model.features:
                 _log.warning("No previous model, or # features changed; "

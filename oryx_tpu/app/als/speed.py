@@ -60,6 +60,9 @@ class ALSSpeedModelManager(AbstractSpeedModelManager):
         if not 0.0 <= self.min_model_load_fraction <= 1.0:
             raise ValueError("min-model-load-fraction must be in [0,1]")
         self._log_rate_limit = RateLimitCheck(60.0)
+        # integrity counters (mirrors the serving manager)
+        self.rejected_updates = 0
+        self.rejected_models = 0
 
     # -- consume -------------------------------------------------------------
 
@@ -67,9 +70,15 @@ class ALSSpeedModelManager(AbstractSpeedModelManager):
         if key == KEY_UP:
             if self.model is None:
                 return  # no model to interpret with yet
-            update = text_utils.read_json(message)
-            kind, id_ = str(update[0]), str(update[1])
-            vector = np.asarray(update[2], dtype=np.float32)
+            parsed = als_common.parse_up_update(message,
+                                                self.model.features)
+            if parsed is None:
+                # malformed, wrong-dimension, or non-finite payload
+                # refused at the trust boundary (shared gate:
+                # als_common.parse_up_update)
+                self.rejected_updates += 1
+                return
+            kind, id_, vector, _extras = parsed
             if kind == "X":
                 self.model.set_user_vector(id_, vector)
             elif kind == "Y":
@@ -82,8 +91,17 @@ class ALSSpeedModelManager(AbstractSpeedModelManager):
             _log.info("Loading new model")
             pmml = read_pmml_from_update_key_message(key, message)
             if pmml is None:
+                self.rejected_models += 1
+                _log.warning("Model document unavailable or corrupt; "
+                             "keeping current model")
                 return
-            features = int(pmml_io.get_extension_value(pmml, "features"))
+            try:
+                features = int(pmml_io.get_extension_value(pmml, "features"))
+            except (TypeError, ValueError):
+                self.rejected_models += 1
+                _log.warning("Model document failed validation; keeping "
+                             "current model")
+                return
             implicit = pmml_io.get_extension_value(pmml, "implicit") == "true"
             log_strength = pmml_io.get_extension_value(pmml, "logStrength") == "true"
             epsilon = (float(pmml_io.get_extension_value(pmml, "epsilon"))

@@ -38,16 +38,24 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...common.rand import RandomManager
+from ...ml.integrity import NumericalDivergenceError
+from ...resilience.faults import fire as _fault
 from .common import ParsedRatings
 
 _log = logging.getLogger(__name__)
 
-__all__ = ["train_als", "ALSModel", "predict_pairs", "score_all_items"]
+__all__ = ["train_als", "rescue_retrain_f64", "ALSModel", "predict_pairs",
+           "score_all_items"]
 
 # max padded interaction slots (B*P) per solve batch; bounds peak memory
 # of the (B, P, k) gather at ~slots*k*4 bytes
 _BATCH_SLOT_BUDGET = 1 << 19
 _MAX_B = 4096
+
+# floor for the escalated-regularization rescue rung: an effectively
+# unregularized candidate (lambda ~ 0) whose f64 systems are still
+# singular gets at least this much
+_RESCUE_MIN_LAMBDA = 1e-3
 
 
 class ALSModel(NamedTuple):
@@ -55,6 +63,11 @@ class ALSModel(NamedTuple):
     item_ids: list[str]
     X: np.ndarray  # (n_users, k) float32
     Y: np.ndarray  # (n_items, k) float32
+    # non-None when the f32 factorization diverged and a rescue rung
+    # produced these factors instead: {"precision", "trigger_iteration",
+    # "escalated_lambda"} — carried into the candidate's PMML so the
+    # generation records HOW it trained, not just that it did
+    rescue: dict | None = None
 
 
 def _next_pow2(x: int) -> int:
@@ -227,6 +240,119 @@ def _solve_side(opposite: jax.Array, plan: _SidePlan,
     return out[:plan.n_rows]
 
 
+def _solve_side_f64_host(opposite: np.ndarray, plan: _SidePlan,
+                         k: int, lam: float, alpha: float,
+                         implicit: bool) -> np.ndarray:
+    """Host float64 half-sweep over the SAME packed batches as the
+    device kernel — identical masking, ALS-WR scaling, and empty-row
+    semantics, only the arithmetic precision differs.  This is the
+    rescue precision: MLlib factors in f64 (ALSUpdate.java:88-152), so
+    a candidate whose f32 normal equations degenerate gets retried
+    here rather than reported as untrainable."""
+    G = opposite.T @ opposite if implicit else None
+    # same sacrificial extra row absorbing dummy (tail padding) indices
+    out = np.zeros((plan.n_rows + 1, k), dtype=np.float64)
+    eye = np.eye(k, dtype=np.float64)
+    for batch_rows, bcols, bvals, bmask in plan.batches:
+        rows = np.asarray(batch_rows)
+        Yg = opposite[np.asarray(bcols)]            # (B, P, k) float64
+        vals = np.asarray(bvals, dtype=np.float64)
+        mask = np.asarray(bmask, dtype=np.float64)
+        n_u = mask.sum(axis=1)
+        if implicit:
+            w = alpha * np.abs(vals) * mask
+            t = (1.0 + w) * (vals > 0.0)
+        else:
+            w = mask
+            t = vals * mask
+        A = np.einsum("bpk,bpl->bkl", Yg * w[:, :, None], Yg)
+        if implicit:
+            A = A + G[None, :, :]
+        A += (lam * np.maximum(n_u, 1.0))[:, None, None] * eye[None]
+        b = np.einsum("bpk,bp->bk", Yg, t)
+        x = np.linalg.solve(A, b[..., None])[..., 0]
+        x[n_u == 0] = 0.0
+        out[rows] = x
+    return out[:plan.n_rows]
+
+
+def _train_f64_host(user_plan: _SidePlan, item_plan: _SidePlan,
+                    n_users: int, n_items: int, k: int, lam: float,
+                    alpha: float, implicit: bool, iterations: int,
+                    seed_val: int) -> tuple[np.ndarray, np.ndarray] | None:
+    """Full float64 host retrain from the same seed/init; returns
+    (X, Y) as float32, or None when even f64 diverges or hits an
+    exactly singular system."""
+    rng = np.random.default_rng(seed_val)
+    Y = rng.standard_normal((n_items, k)) / math.sqrt(k)
+    try:
+        for _ in range(iterations):
+            X = _solve_side_f64_host(Y, user_plan, k, lam, alpha, implicit)
+            Y = _solve_side_f64_host(X, item_plan, k, lam, alpha, implicit)
+    except np.linalg.LinAlgError:
+        return None
+    if not (np.all(np.isfinite(X)) and np.all(np.isfinite(Y))):
+        return None
+    return X.astype(np.float32), Y.astype(np.float32)
+
+
+def _factors_finite(X: jax.Array, Y: jax.Array) -> bool:
+    # NaN-propagating sums: two scalars cross the transport, not the
+    # factor matrices
+    return bool(jnp.isfinite(jnp.sum(X)) & jnp.isfinite(jnp.sum(Y)))
+
+
+def _f64_ladder(user_plan: _SidePlan, item_plan: _SidePlan,
+                n_users: int, n_items: int, k: int, lam: float,
+                alpha: float, implicit: bool, iterations: int,
+                seed_val: int, trigger_iteration: int | None
+                ) -> tuple[np.ndarray, np.ndarray, dict]:
+    """The f64 -> escalated-lambda rungs shared by train_als and the
+    distributed trainer's rescue; returns (X, Y, rescue annotation) or
+    raises NumericalDivergenceError when both rungs fail."""
+    rescue = {"precision": "float64", "trigger_iteration": trigger_iteration,
+              "escalated_lambda": None}
+    factors = _train_f64_host(user_plan, item_plan, n_users, n_items, k,
+                              lam, alpha, implicit, iterations, seed_val)
+    if factors is None:
+        lam_esc = max(lam * 10.0, _RESCUE_MIN_LAMBDA)
+        _log.warning("float64 retrain also diverged; escalating "
+                     "regularization lambda %g -> %g", lam, lam_esc)
+        rescue["escalated_lambda"] = lam_esc
+        factors = _train_f64_host(user_plan, item_plan, n_users, n_items,
+                                  k, lam_esc, alpha, implicit, iterations,
+                                  seed_val)
+        if factors is None:
+            raise NumericalDivergenceError(
+                f"ALS diverged at every rescue rung (features={k} "
+                f"lambda={lam}, escalated {lam_esc})")
+    X_r, Y_r = factors
+    _log.info("ALS float64 rescue succeeded (%s)", rescue)
+    return X_r, Y_r, rescue
+
+
+def rescue_retrain_f64(ratings: ParsedRatings, features: int, lam: float,
+                       alpha: float, implicit: bool, iterations: int,
+                       seed: int | None = None) -> ALSModel:
+    """Standalone f64 rescue for factorization paths without an in-loop
+    ladder (the distributed trainer): repack the interactions and run
+    the f64 -> escalated-lambda rungs directly.  Returns a
+    rescue-annotated ALSModel or raises NumericalDivergenceError."""
+    n_users = len(ratings.user_ids)
+    n_items = len(ratings.item_ids)
+    user_plan = _pack_side(ratings.users, ratings.items, ratings.values,
+                           n_users)
+    item_plan = _pack_side(ratings.items, ratings.users, ratings.values,
+                           n_items)
+    seed_val = RandomManager.random_seed() if seed is None else seed
+    X_r, Y_r, rescue = _f64_ladder(user_plan, item_plan, n_users, n_items,
+                                   features, lam, alpha, implicit,
+                                   iterations, seed_val,
+                                   trigger_iteration=None)
+    return ALSModel(ratings.user_ids, ratings.item_ids, X_r, Y_r,
+                    rescue=rescue)
+
+
 def train_als(ratings: ParsedRatings,
               features: int,
               lam: float,
@@ -240,6 +366,15 @@ def train_als(ratings: ParsedRatings,
 
     `on_iteration(i, X, Y)` fires after each full sweep — used by the
     bench harness for per-epoch timing/convergence traces.
+
+    Numerical rescue ladder: the f32 device factorization is checked
+    for divergence after every sweep; on NaN/Inf the candidate retrains
+    in float64 on host (same seed and init), and if even f64 cannot
+    train it, once more with escalated regularization.  The returned
+    model's ``rescue`` field records the rung taken; only a candidate
+    that exhausts the ladder raises NumericalDivergenceError.  This
+    keeps the usable hyperparameter region as wide as the reference's
+    f64 MLlib trainer instead of silently narrower.
     """
     n_users = len(ratings.user_ids)
     n_items = len(ratings.item_ids)
@@ -253,27 +388,46 @@ def train_als(ratings: ParsedRatings,
     item_plan = _pack_side(ratings.items, ratings.users, ratings.values,
                            n_items)
 
-    rng = np.random.default_rng(
-        RandomManager.random_seed() if seed is None else seed)
+    seed_val = RandomManager.random_seed() if seed is None else seed
+    rng = np.random.default_rng(seed_val)
     # small random init, scaled like MLlib's (normalized gaussian / sqrt(k))
     Y = jnp.asarray(
         (rng.standard_normal((n_items, k)) / math.sqrt(k)).astype(np.float32))
     X = jnp.zeros((n_users, k), dtype=jnp.float32)
 
+    diverged_at = None
     for it in range(iterations):
         # factors never leave the device between half-sweeps
         X = _solve_side(Y, user_plan, k, lam, alpha, implicit)
         Y = _solve_side(X, item_plan, k, lam, alpha, implicit)
-        if _log.isEnabledFor(logging.INFO):
-            # sync (not copy) so the progress log reflects work actually
-            # done — everything dispatches asynchronously otherwise
-            Y.block_until_ready()
+        # chaos seam: poison this sweep's factors so tests drive the
+        # rescue ladder deterministically on healthy data
+        if _fault("trainer-f32-poison") == "drop":
+            X = X.at[0, 0].set(jnp.nan)
+        # one transport round trip per sweep — deliberate: divergence
+        # typically appears within the first couple of sweeps, and
+        # breaking early saves whole sweeps of NaN compute (and pins
+        # trigger_iteration), worth far more than the RTT the
+        # INFO-logging sync below was already paying in practice
+        if not _factors_finite(X, Y):
+            diverged_at = it
+            break
         _log.info("ALS iteration %d/%d done", it + 1, iterations)
         if on_iteration is not None:
             on_iteration(it, np.asarray(X), np.asarray(Y))
 
-    return ALSModel(ratings.user_ids, ratings.item_ids,
-                    np.asarray(X), np.asarray(Y))
+    if diverged_at is None:
+        return ALSModel(ratings.user_ids, ratings.item_ids,
+                        np.asarray(X), np.asarray(Y))
+
+    _log.warning("ALS f32 factorization diverged at iteration %d/%d "
+                 "(features=%d lambda=%g); rescuing in float64",
+                 diverged_at + 1, iterations, k, lam)
+    X_r, Y_r, rescue = _f64_ladder(user_plan, item_plan, n_users, n_items,
+                                   k, lam, alpha, implicit, iterations,
+                                   seed_val, trigger_iteration=diverged_at)
+    return ALSModel(ratings.user_ids, ratings.item_ids, X_r, Y_r,
+                    rescue=rescue)
 
 
 @jax.jit

@@ -27,6 +27,7 @@ from ...common import text as text_utils
 from ...common.config import Config
 from ...kafka.api import KEY_UP, KeyMessage, TopicProducer
 from ...ml import params as hp
+from ...ml.integrity import NumericalDivergenceError, is_finite_array
 from ...ml.mlupdate import MLUpdate
 from . import common as als_common
 from . import evaluation
@@ -115,14 +116,41 @@ class ALSUpdate(MLUpdate):
                                          self.decay_zero_threshold)
         ratings = als_common.aggregate(events, self.implicit,
                                        self.log_strength, epsilon)
-        if self.mesh is not None:
-            from ...parallel.als_dist import train_als_distributed
-            model = train_als_distributed(ratings, features, lam, alpha,
-                                          self.implicit, self.iterations,
-                                          self.mesh)
-        else:
-            model = train_als(ratings, features, lam, alpha, self.implicit,
-                              self.iterations)
+        try:
+            if self.mesh is not None:
+                from ...parallel.als_dist import train_als_distributed
+                model = train_als_distributed(ratings, features, lam, alpha,
+                                              self.implicit, self.iterations,
+                                              self.mesh)
+                if not (is_finite_array(model.X)
+                        and is_finite_array(model.Y)):
+                    # the distributed trainer has no in-loop ladder;
+                    # give its diverged candidates the same f64 rescue
+                    # the single-device path gets
+                    _log.warning("Distributed ALS diverged "
+                                 "(features=%d lambda=%g); rescuing in "
+                                 "float64 on host", features, lam)
+                    from .trainer import rescue_retrain_f64
+                    model = rescue_retrain_f64(ratings, features, lam,
+                                               alpha, self.implicit,
+                                               self.iterations)
+            else:
+                model = train_als(ratings, features, lam, alpha, self.implicit,
+                                  self.iterations)
+        except NumericalDivergenceError:
+            # every rescue rung failed: a clean per-candidate failure —
+            # the search skips it; one bad combo must not kill the sweep
+            _log.exception("Candidate (features=%d lambda=%g) diverged "
+                           "beyond rescue; skipping", features, lam)
+            return None
+        # cheap in-memory gate BEFORE the artifacts are written: the
+        # rescue ladder should make this unreachable, and catching a
+        # regression here costs one array pass instead of a round trip
+        # through the gzipped artifacts
+        if not (is_finite_array(model.X) and is_finite_array(model.Y)):
+            _log.warning("Candidate (features=%d lambda=%g) produced "
+                         "non-finite factors; skipping", features, lam)
+            return None
         return self._model_to_pmml(model, features, lam, alpha, epsilon,
                                    candidate_path)
 
@@ -145,6 +173,10 @@ class ALSUpdate(MLUpdate):
         pmml_io.add_extension(doc, "logStrength", self.log_strength)
         if self.log_strength:
             pmml_io.add_extension(doc, "epsilon", epsilon)
+        if model.rescue is not None:
+            # the generation records HOW it trained: precision rung and
+            # any regularization escalation the rescue ladder took
+            pmml_io.add_extension(doc, "rescue", json.dumps(model.rescue))
         pmml_io.add_extension_content(doc, "XIDs", model.user_ids)
         pmml_io.add_extension_content(doc, "YIDs", model.item_ids)
         return doc
@@ -186,6 +218,23 @@ class ALSUpdate(MLUpdate):
         err = evaluation.rmse(X, Y, users, items, values)
         _log.info("RMSE: %s", err)
         return -err
+
+    # -- pre-publish integrity ----------------------------------------------
+
+    def validate_model(self, model: Element, candidate_path: str) -> bool:
+        """The ARTIFACTS must be fully finite before the candidate is
+        eligible to win publication: this validates what consumers will
+        actually read (the in-memory factors are gated separately and
+        cheaply in build_model), so a write-path corruption cannot ship.
+        Cost is one load per candidate — the same class evaluate()
+        already pays, and training dwarfs both."""
+        for side in ("X", "Y"):
+            _, matrix = load_features(store.join(candidate_path, side))
+            if not is_finite_array(matrix):
+                _log.warning("Candidate at %s has non-finite %s factors; "
+                             "rejecting", candidate_path, side)
+                return False
+        return True
 
     # -- publish ------------------------------------------------------------
 

@@ -15,6 +15,8 @@ from xml.etree.ElementTree import Element
 from ..common import pmml as pmml_io
 from ..common import text as text_utils
 from ..kafka.api import KEY_MODEL, KEY_MODEL_REF
+from ..ml.integrity import ModelIntegrityError
+from ..resilience.faults import fire as _fault
 from .schema import CategoricalValueEncodings, InputSchema
 
 _log = logging.getLogger(__name__)
@@ -121,15 +123,35 @@ def read_pmml_from_update_key_message(key: str, message: str) -> Element | None:
     the scheme-routed store, so a serving process reads a model the
     trainer published on a shared filesystem/object store (reference:
     AppPMMLUtils.readPMMLFromUpdateKeyMessage :259 opens the HDFS
-    path)."""
+    path).
+
+    Corrupt documents (truncated artifact, mangled payload) return None
+    with a warning, exactly like a missing file: the consumers run on
+    replay-from-0 resubscribe loops, so a raised parse error would turn
+    one poison message into an infinite resubscribe cycle.  The
+    ``store-corrupt-model`` injection point (config key
+    ``oryx.resilience.faults.store-corrupt-model``) lets the chaos
+    suite drive this path deterministically."""
     if key == KEY_MODEL:
-        return pmml_io.from_string(message)
+        try:
+            return pmml_io.from_string(message)
+        except ET.ParseError:
+            _log.warning("Ignoring corrupt inline model message (%d bytes)",
+                         len(message))
+            return None
     if key == KEY_MODEL_REF:
         # open-and-catch, not exists-then-read: TTL cleanup may race
         # the resolve, and one round trip beats two on a remote store
         try:
+            # chaos seam: a corrupt/truncated artifact at the ref path
+            _fault("store-corrupt-model", error=lambda: ModelIntegrityError(
+                f"injected corrupt model artifact at {message}"))
             return pmml_io.read(message)
         except (FileNotFoundError, OSError):
             _log.warning("Unable to load model file at %s; ignoring", message)
+            return None
+        except (ET.ParseError, ModelIntegrityError):
+            _log.warning("Corrupt or truncated model artifact at %s; "
+                         "ignoring", message)
             return None
     raise ValueError(f"Bad key: {key}")

@@ -63,12 +63,17 @@ def run_sweep(ratings: int = 2_000_000, data_path: str | None = None,
         def evaluate(self, model, candidate_path, test_data, train_data):
             e = super().evaluate(model, candidate_path, test_data,
                                  train_data)
+            rescue = pmml_io.get_extension_value(model, "rescue")
             evals.append({
                 "features": int(pmml_io.get_extension_value(model,
                                                             "features")),
                 "lambda": float(pmml_io.get_extension_value(model,
                                                             "lambda")),
                 "eval": float(e),
+                # which rescue rung (if any) trained this candidate:
+                # None = clean f32, else {precision, trigger_iteration,
+                # escalated_lambda}
+                "rescue": json.loads(rescue) if rescue else None,
             })
             return e
 
@@ -98,15 +103,23 @@ def run_sweep(ratings: int = 2_000_000, data_path: str | None = None,
             "lambda": float(pmml_io.get_extension_value(doc, "lambda")),
         }
 
-    # NaN evals are degenerate candidates the search REJECTS (reference
-    # semantics: MLUpdate skips NaN; e.g. an underregularized lambda
-    # producing singular solves) — the gate is argmax of the finite ones
+    # The rescue ladder (f32 -> f64 -> escalated lambda) means EVERY
+    # candidate of the reference's grid trains — 0 NaN evals is the
+    # gate (MLlib trains f64 at lambda=5e-4; pre-rescue the f32 path
+    # diverged there and half the grid was lost).  Argmax is over the
+    # finite evals; each candidate records the rescue rung it needed.
     finite = [d for d in evals if d["eval"] == d["eval"]]
+    nan_candidates = len(evals) - len(finite)
+    # candidates that never reached evaluate() at all (diverged beyond
+    # rescue, or rejected by the pre-publish gate) are just as lost as
+    # NaN ones — the 0-NaN acceptance gate must count them too
+    missing_candidates = n_candidates - len(evals)
     best = max(finite, key=lambda d: d["eval"]) if finite else None
     gate_ok = (best is not None
                and chosen["features"] == best["features"]
                and chosen["lambda"] == best["lambda"]
                and len(evals) == n_candidates)
+    rescued = [d for d in evals if d.get("rescue")]
     return {
         "metric": "als_hyperparam_sweep",
         "dataset": source,
@@ -117,6 +130,18 @@ def run_sweep(ratings: int = 2_000_000, data_path: str | None = None,
         "chosen": chosen,
         "eval_metric": "-RMSE (explicit; Evaluation.java:49-63 semantics)",
         "published_is_argmax": gate_ok,
+        "nan_candidates": nan_candidates,
+        "missing_candidates": missing_candidates,
+        "all_candidates_trained": (nan_candidates == 0
+                                   and missing_candidates == 0),
+        "rescued_candidates": len(rescued),
+        "rescues": {
+            "float64": sum(1 for d in rescued
+                           if d["rescue"].get("escalated_lambda") is None),
+            "escalated_lambda": sum(
+                1 for d in rescued
+                if d["rescue"].get("escalated_lambda") is not None),
+        },
         "eval_parallelism": 2,
         "sweep_wall_s": round(sweep_s, 1),
         "csv_encode_s": round(encode_s, 1),
@@ -136,6 +161,9 @@ def main() -> None:
 
     result["device"] = str(jax.devices()[0].platform)
     assert result["published_is_argmax"], result
+    # ISSUE 2 acceptance gate: every grid candidate (including the
+    # lambda=5e-4 half MLlib can train and f32-only could not) trained
+    assert result["all_candidates_trained"], result
     line = json.dumps(result)
     print(line)
     if args.out:

@@ -159,6 +159,15 @@ def rename(src_uri: str, dst_uri: str) -> None:
     eventual-visibility contract the reference relies on HDFS rename
     for (readers only learn the path from the update topic *after* the
     move completes)."""
+    # the remote branch resolves ONE filesystem (from src) and reuses it
+    # for dst — a cross-scheme rename (memory:// -> s3://) would operate
+    # on the wrong store entirely, so refuse it up front (VERDICT Weak
+    # #7; unreachable via current callers, which rename temp -> final
+    # within one store)
+    if _scheme(src_uri) != _scheme(dst_uri):
+        raise ValueError(
+            f"rename requires matching URI schemes: {src_uri} -> {dst_uri}")
+
     def _do() -> None:
         # chaos seam: transient rename failure on the publish edge
         _fault("store-rename", error=lambda: OSError(

@@ -115,11 +115,15 @@ class SpeedLayer:
                 updates = self.model_manager.build_updates(new_data)
                 for update in updates:
                     self._producer.send(KEY_UP, update)
+                # commit BEFORE advancing the in-memory position: a
+                # failed commit must leave pos behind so the batch
+                # redelivers next interval (duplicate UP deltas are
+                # at-least-once; a silently stale broker offset is not)
+                broker.set_offsets(self._group, self.input_topic, ends)
                 pos = ends
-                broker.set_offsets(self._group, self.input_topic, pos)
             except Exception:  # noqa: BLE001 — micro-batch failure is
                 _log.exception("Micro-batch failed")  # survivable
-                # pos is unchanged unless every delta published, so the
+                # pos is unchanged unless the commit landed, so the
                 # failed batch redelivers in full next interval
 
     def run_one_micro_batch(self) -> None:

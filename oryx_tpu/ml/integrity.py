@@ -1,0 +1,55 @@
+"""Model-integrity primitives: the numerical-trust boundary of the
+update path.
+
+The lambda loop moves models through several hand-offs — trainer →
+PMML + factor artifacts → update topic → speed/serving managers — and
+PR 1 made the *transport* of those hand-offs resilient.  This module is
+the *content* side: a model that arrives intact but carries NaN/Inf
+factors (a diverged candidate, a truncated artifact, a poison UP
+message) is just as fatal to serving quality as a lost message, and
+silently worse because nothing times out.  Every producer-side gate
+(`ml/mlupdate.py` pre-publish validation) and consumer-side gate
+(speed/serving managers, `app/pmml_utils.py`) shares these checks so
+"finite" means the same thing at every hand-off.
+
+Reference: MLlib-side training is f64 and MLUpdate.java:254-296 skips
+NaN *evals*; nothing in the reference validates factor payloads because
+JVM double arithmetic rarely manufactures NaN at these scales.  The f32
+device path can, so the gates are load-bearing here.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "ModelIntegrityError", "NumericalDivergenceError",
+    "is_finite_array", "check_finite_array",
+]
+
+
+class ModelIntegrityError(Exception):
+    """A model artifact or update payload failed an integrity check
+    (non-finite factors, truncated/corrupt document, missing fields).
+    Consumers treat it like a lost message: log, count, keep serving
+    the previous model."""
+
+
+class NumericalDivergenceError(ModelIntegrityError):
+    """Training diverged to non-finite factors and every rung of the
+    rescue ladder (f32 -> f64 -> escalated regularization) failed."""
+
+
+def is_finite_array(a) -> bool:
+    """True when every element is finite (empty arrays are finite)."""
+    a = np.asarray(a)
+    return a.size == 0 or bool(np.all(np.isfinite(a)))
+
+
+def check_finite_array(name: str, a) -> None:
+    """Raise ModelIntegrityError when ``a`` holds NaN/Inf."""
+    a = np.asarray(a)
+    if not is_finite_array(a):
+        bad = int(a.size - np.count_nonzero(np.isfinite(a)))
+        raise ModelIntegrityError(
+            f"{name} has {bad} non-finite entries (shape {a.shape})")

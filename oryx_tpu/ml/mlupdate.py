@@ -13,6 +13,7 @@ from __future__ import annotations
 import abc
 import contextlib
 import logging
+import math
 import os
 import time
 from typing import Sequence
@@ -77,6 +78,13 @@ class MLUpdate(BatchLayerUpdate, abc.ABC):
                  test_data: Sequence[KeyMessage],
                  train_data: Sequence[KeyMessage]) -> float:
         """Higher is better (negate error metrics)."""
+
+    def validate_model(self, model: Element, candidate_path: str) -> bool:
+        """Pre-publish integrity gate: return False to reject the
+        candidate outright (it can never be selected or published).
+        Subclasses override to check model content — e.g. ALS verifies
+        every factor artifact is finite.  The default accepts."""
+        return True
 
     def can_publish_additional_model_data(self) -> bool:
         return False
@@ -167,7 +175,11 @@ class MLUpdate(BatchLayerUpdate, abc.ABC):
         for path, eval_ in results:
             if path is None or not store.exists(path):
                 continue
-            if eval_ == eval_:  # not NaN
+            if math.isfinite(eval_):
+                # argmax strictly over FINITE evals: NaN is the
+                # reference's skip semantics (MLUpdate.java:254-296),
+                # and +/-Inf is a degenerate metric no candidate may
+                # win with — garbage never outranks a real model
                 if eval_ > best_eval:
                     _log.info("Best eval / model path is now %s / %s", eval_, path)
                     best_eval, best_path = eval_, path
@@ -198,6 +210,13 @@ class MLUpdate(BatchLayerUpdate, abc.ABC):
         store.mkdirs(candidate_path)
         model_path = store.join(candidate_path, MODEL_FILE_NAME)
         pmml_io.write(model, model_path)
+        # pre-publish integrity gate: a candidate that fails validation
+        # is dropped entirely (path=None) so no selection branch — not
+        # even the eval-disabled one — can ever publish it
+        if not self.validate_model(model, candidate_path):
+            _log.warning("Model for params %s failed integrity validation; "
+                         "rejecting candidate %s", hyper_parameters, i)
+            return None, eval_
         if not test:
             _log.info("No test data available to evaluate model")
         else:

@@ -12,15 +12,30 @@ rank-revealing QR); the factorization kept for solving is a Cholesky
 factor resident on device, so the hot path — thousands of fold-in solves
 per micro-batch — is a single batched triangular solve on the MXU rather
 than one host solve per event.
+
+Numerical rescue: MLlib factors in float64 (ALSUpdate.java:88-152) while
+the device factor here is float32, so a Gramian that is marginally
+positive-definite in f64 can come back NaN from the f32 Cholesky.
+Rather than surface that as "singular" (narrowing the usable
+hyperparameter region below the reference's), ``get_solver`` retries the
+factorization in float64 on host and, when that succeeds, returns a
+solver that solves in f64 — slower per call, but these are k x k systems
+and the rescue path is the exception, not the rule.  Only a matrix the
+f64 Cholesky also rejects raises SingularMatrixSolverException.
 """
 
 from __future__ import annotations
 
+import logging
 from functools import partial
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from ..resilience.faults import fire as _fault
+
+_log = logging.getLogger(__name__)
 
 __all__ = ["Solver", "SingularMatrixSolverException", "get_solver", "unpack_packed"]
 
@@ -46,12 +61,37 @@ class Solver:
 
     ``solve`` accepts a single right-hand side (k,) or a batch (n, k) and
     returns the same shape; the batch path is one fused device solve.
+
+    ``precision`` is "float32" (device Cholesky, the fast path) or
+    "float64" (host f64 Cholesky, the rescue path for Gramians whose f32
+    factorization degenerates — see module docstring).
     """
 
-    def __init__(self, chol: jax.Array):
+    def __init__(self, chol: jax.Array, chol64: np.ndarray | None = None):
+        # f64 rescue mode: chol64 is the host float64 lower factor and
+        # is authoritative for solves; the device f32 factor is kept
+        # (cast from f64, finite by construction) for batched kernels
+        # that consume .cholesky directly.
         self._chol = chol
+        self._chol64 = chol64
+
+    @property
+    def precision(self) -> str:
+        return "float32" if self._chol64 is None else "float64"
+
+    def _solve64(self, b) -> np.ndarray:
+        """Host float64 solve against the rescue factor; shape-preserving."""
+        import scipy.linalg
+        b64 = np.asarray(b, dtype=np.float64)
+        single = b64.ndim == 1
+        if single:
+            b64 = b64[None, :]
+        x = scipy.linalg.cho_solve((self._chol64, True), b64.T).T
+        return x[0] if single else x
 
     def solve(self, b) -> np.ndarray:
+        if self._chol64 is not None:
+            return self._solve64(b).astype(np.float32)
         b = jnp.asarray(b, dtype=jnp.float32)
         single = b.ndim == 1
         if single:
@@ -62,6 +102,8 @@ class Solver:
 
     # reference Solver.solveDToD / solveFToF parity names
     def solve_d_to_d(self, b) -> np.ndarray:
+        if self._chol64 is not None:
+            return self._solve64(b)
         return self.solve(np.asarray(b, dtype=np.float64)).astype(np.float64)
 
     def solve_f_to_f(self, b) -> np.ndarray:
@@ -73,7 +115,7 @@ class Solver:
         return self._chol
 
     def __repr__(self):  # pragma: no cover
-        return f"Solver(k={self._chol.shape[0]})"
+        return f"Solver(k={self._chol.shape[0]}, {self.precision})"
 
 
 def unpack_packed(packed: np.ndarray) -> np.ndarray:
@@ -101,6 +143,11 @@ def get_solver(a) -> Solver:
     a = np.asarray(a, dtype=np.float64)
     if a.ndim == 1:
         a = unpack_packed(a)
+    # a Gramian built from NaN-poisoned factors must surface as a clean
+    # solver failure, not a LinAlgError out of the SVD below
+    if a.size and not np.all(np.isfinite(a)):
+        raise SingularMatrixSolverException(
+            0, f"{a.shape[0]} x {a.shape[1]} matrix has non-finite entries")
     # inf-norm (max absolute row sum), as commons-math RealMatrix.getNorm()
     inf_norm = float(np.max(np.sum(np.abs(a), axis=1))) if a.size else 0.0
     threshold = inf_norm * _SINGULARITY_THRESHOLD_RATIO
@@ -112,11 +159,24 @@ def get_solver(a) -> Solver:
             f"{a.shape[0]} x {a.shape[1]} matrix is near-singular "
             f"(threshold {threshold}). Apparent rank: {apparent_rank}")
     chol = jnp.linalg.cholesky(jnp.asarray(a, dtype=jnp.float32))
+    # chaos seam: discard the f32 factorization so tests can drive the
+    # f64 rescue branch deterministically on a healthy matrix
+    f32_ok = _fault("solver-f32-discard") != "drop" \
+        and not bool(jnp.any(jnp.isnan(chol)))
+    if f32_ok:
+        return Solver(chol)
     # Cholesky silently yields NaN for indefinite A (symmetric but not
-    # PD can still pass the SVD singularity gate) — reject it here
-    # rather than let NaN propagate into every later solve
-    if bool(jnp.any(jnp.isnan(chol))):
+    # PD can still pass the SVD singularity gate) and for matrices whose
+    # positive-definiteness does not survive the f32 downcast.  Retry in
+    # float64 on host (MLlib's working precision); only a matrix f64
+    # also rejects is truly not PD.
+    try:
+        chol64 = np.linalg.cholesky(a)
+    except np.linalg.LinAlgError:
         raise SingularMatrixSolverException(
             apparent_rank,
-            f"matrix is not positive definite; apparent rank: {apparent_rank}")
-    return Solver(chol)
+            f"matrix is not positive definite; apparent rank: "
+            f"{apparent_rank}") from None
+    _log.warning("f32 Cholesky degenerated for %dx%d Gramian; rescued "
+                 "with float64 host factorization", a.shape[0], a.shape[1])
+    return Solver(jnp.asarray(chol64.astype(np.float32)), chol64=chol64)

@@ -105,6 +105,15 @@ def _metrics(req: Request):
     app_metrics = getattr(model, "metrics", None)
     if callable(app_metrics):
         out["model_metrics"] = app_metrics()
+    # consumer-side integrity counters: poison updates / corrupt model
+    # documents the manager refused (numerical trust boundary evidence)
+    manager = req.context["model_manager"]
+    rejected_updates = getattr(manager, "rejected_updates", None)
+    if rejected_updates is not None:
+        out["model_integrity"] = {
+            "rejected_updates": rejected_updates,
+            "rejected_models": getattr(manager, "rejected_models", 0),
+        }
     return out
 
 

@@ -19,6 +19,7 @@ The three headline scenarios (ISSUE acceptance criteria):
 """
 
 import json
+import os
 import threading
 import time
 import urllib.error
@@ -28,7 +29,7 @@ import numpy as np
 import pytest
 
 from oryx_tpu.common.config import from_dict
-from oryx_tpu.kafka.api import KEY_MODEL, KEY_UP, KeyMessage
+from oryx_tpu.kafka.api import KEY_MODEL, KEY_MODEL_REF, KEY_UP, KeyMessage
 from oryx_tpu.kafka.client import KafkaBroker
 from oryx_tpu.kafka.inproc import get_broker
 from oryx_tpu.kafka.mini_broker import MiniKafkaBroker
@@ -364,6 +365,116 @@ def test_request_deadline_sheds_expired_work_as_503(tmp_path):
         assert recs and "id" in recs[0]
     finally:
         serving.close()
+
+
+# -- model integrity: corrupt/truncated MODEL-REF artifacts ------------------
+
+def test_corrupt_model_ref_degrades_to_503_and_recovers(tmp_path):
+    """The ISSUE 2 integrity scenario: a corrupt MODEL-REF artifact
+    (driven deterministically through the ``store-corrupt-model`` fault
+    point) must take the consumer's clean error path — no dead consume
+    thread, no resubscribe storm — leaving serving gated at 503, and
+    the NEXT published generation must restore service with no
+    restart."""
+    cfg = _base_config(
+        tmp_path, "chaos7",
+        # force overflow-by-reference publishing: the model travels as
+        # a MODEL-REF path into the shared store, the integrity surface
+        # under test
+        **{"oryx.update-topic.message.max-size": 100})
+    broker = get_broker("chaos7")
+    _produce_ratings(broker, "ItInput")
+    BatchLayer(cfg).run_one_generation()
+    refs = [m for m in _drain(broker, "ItUpdate") if m.key == KEY_MODEL_REF]
+    assert len(refs) == 1, "expected an overflowed MODEL-REF publish"
+
+    faults.inject("store-corrupt-model", mode="error", times=1)
+    serving = ServingLayer(cfg, port=0)
+    serving.start()
+    try:
+        # replay hits the injected corruption: rejected and counted
+        deadline = Deadline.after(15.0)
+        while serving.model_manager.rejected_models < 1 \
+                and not deadline.expired:
+            time.sleep(0.02)
+        assert serving.model_manager.rejected_models >= 1
+        assert faults.fired("store-corrupt-model") == 1
+        assert serving.model_manager.get_model() is None
+        # reads gate at 503 — garbage was refused, not served
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get_json(serving.port, "/recommend/u0")
+        assert exc.value.code == 503
+        # the consumer survived its poison message (clean error path)
+        assert serving._consume_thread.is_alive()
+        # the refusal is operator-visible on /metrics
+        snap = _get_json(serving.port, "/metrics")
+        assert snap["model_integrity"]["rejected_models"] >= 1
+
+        # recovery: the next generation republishes model + factors;
+        # the fault is exhausted, so the ref loads and service returns
+        # WITHOUT a serving restart
+        BatchLayer(cfg).run_one_generation()
+        model = _await_model(serving)
+        uid = model.all_user_ids()[0]
+        recs = _get_json(serving.port, f"/recommend/{uid}")
+        assert recs and "id" in recs[0]
+    finally:
+        serving.close()
+
+
+def test_truncated_model_artifact_is_rejected_not_fatal(tmp_path):
+    """A REAL truncated artifact on disk (no injection): the speed
+    consumer must reject it and keep the model it already has."""
+    cfg = _base_config(tmp_path, "chaos8")
+    broker = get_broker("chaos8")
+    _produce_ratings(broker, "ItInput")
+    BatchLayer(cfg).run_one_generation()
+
+    speed = SpeedLayer(cfg)
+    _replay_into(speed.model_manager, broker)
+    model_before = speed.model_manager.model
+    assert model_before is not None
+    users_before = sorted(model_before.X.all_ids())
+
+    published = [d for d in os.listdir(tmp_path / "model") if d.isdigit()]
+    src = tmp_path / "model" / published[0] / "model.pmml.xml"
+    content = src.read_bytes()
+    trunc = tmp_path / "model" / "truncated.pmml.xml"
+    trunc.write_bytes(content[:len(content) // 2])
+    broker.send("ItUpdate", KEY_MODEL_REF, str(trunc))
+
+    _replay_into(speed.model_manager, broker)
+    assert speed.model_manager.rejected_models >= 1
+    model = speed.model_manager.model
+    assert model is not None
+    assert sorted(model.X.all_ids()) == users_before
+
+
+def test_nonfinite_up_message_is_rejected(tmp_path):
+    """A NaN-bearing UP payload (JSON NaN is representable) must be
+    refused at the consumer trust boundary, never folded into factors."""
+    cfg = _base_config(tmp_path, "chaos9")
+    broker = get_broker("chaos9")
+    _produce_ratings(broker, "ItInput")
+    BatchLayer(cfg).run_one_generation()
+
+    speed = SpeedLayer(cfg)
+    _replay_into(speed.model_manager, broker)
+    manager = speed.model_manager
+    uid = sorted(manager.model.X.all_ids())[0]
+    before = manager.model.get_user_vector(uid).copy()
+
+    manager.consume_key_message(KEY_UP, f'["X", "{uid}", [NaN, NaN, NaN]]')
+    manager.consume_key_message(KEY_UP, '["X", "u0", "not-a-vector"]')
+    manager.consume_key_message(KEY_UP, "{corrupt json")
+    # a JSON *object* indexes by key (KeyError class), and a finite but
+    # wrong-dimension vector would broadcast-corrupt the factor row
+    manager.consume_key_message(KEY_UP, '{"a": 1}')
+    manager.consume_key_message(KEY_UP, f'["X", "{uid}", [0.5]]')
+    assert manager.rejected_updates == 5
+    vec = manager.model.get_user_vector(uid)
+    np.testing.assert_array_equal(vec, before)
+    assert np.all(np.isfinite(vec))
 
 
 # -- supervised restart of a crashed layer thread ----------------------------

@@ -157,3 +157,31 @@ def test_missing_model_ref_is_tolerated():
     from oryx_tpu.app.pmml_utils import read_pmml_from_update_key_message
     assert read_pmml_from_update_key_message(
         "MODEL-REF", "memory://lake/model/nope.pmml.xml") is None
+
+
+def test_corrupt_model_ref_is_tolerated(tmp_path):
+    """A truncated artifact behind a MODEL-REF returns None with a
+    warning, like a missing file — never a raised parse error (the
+    consumers replay-from-0 on failure, so a poison ref would loop)."""
+    from oryx_tpu.app.pmml_utils import read_pmml_from_update_key_message
+    bad = tmp_path / "model.pmml.xml"
+    bad.write_text("<PMML version='4.4'><Header/><Extensio")  # truncated
+    assert read_pmml_from_update_key_message("MODEL-REF", str(bad)) is None
+    # inline corrupt MODEL payloads are tolerated the same way
+    assert read_pmml_from_update_key_message("MODEL", "<PMML><unclosed") \
+        is None
+
+
+def test_rename_rejects_cross_scheme_uris(tmp_path):
+    """rename() resolves ONE filesystem and reuses it for both ends; a
+    cross-scheme move would run against the wrong store (VERDICT Weak
+    #7), so it must refuse loudly."""
+    src = "memory://bucket/a.txt"
+    with store.open_write(src) as f:
+        f.write(b"x")
+    with pytest.raises(ValueError, match="matching URI schemes"):
+        store.rename(src, f"file://{tmp_path}/a.txt")
+    with pytest.raises(ValueError, match="matching URI schemes"):
+        store.rename(f"file://{tmp_path}/a.txt", src)
+    # the refused rename moved nothing
+    assert store.exists(src)
