@@ -129,10 +129,21 @@ class FactorModelBase:
     def set_expected_ids(self, user_ids: Sequence[str],
                          item_ids: Sequence[str]) -> None:
         """Record the ID universe of an incoming MODEL for fraction-loaded
-        accounting (reference expected-ID logic, ALSServingModel.java:318-343)."""
+        accounting (reference expected-ID logic, ALSServingModel.java:318-343).
+        Also pre-sizes both stores for that universe: the UP replay that
+        follows then fills rows in place instead of regrowing (a regrow
+        re-uploads the whole device snapshot AND lands on an
+        intermediate pow2 capacity the AOT warmup never compiled)."""
         with self._expected_lock:
             self._expected_user_ids = {u for u in user_ids if u not in self.X}
             self._expected_item_ids = {i for i in item_ids if i not in self.Y}
+            # rows occupied by the PREVIOUS generation stay occupied
+            # until the retain pass after replay, so the reservation
+            # must cover current occupancy PLUS the not-yet-present
+            # expected ids — sizing to the new universe alone could
+            # still regrow mid-replay
+            self.X.reserve(len(self.X) + len(self._expected_user_ids))
+            self.Y.reserve(len(self.Y) + len(self._expected_item_ids))
 
     def retain_recent_and_user_ids(self, ids: Sequence[str]) -> None:
         self.X.retain_recent_and_ids(ids)

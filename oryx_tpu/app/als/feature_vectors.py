@@ -40,6 +40,23 @@ _FULL_UPLOAD_FRACTION = 0.5
 _LARGE_ALIGN = 1 << 17
 
 
+def planned_capacity(n_rows: int, initial_capacity: int = 1024) -> int:
+    """The padded row capacity a fresh store ends up with after a
+    single ``bulk_load`` of ``n_rows`` vectors — the compiled leading
+    dimension every serving kernel sees for a model of that size.  The
+    deploy-time AOT warmup (deploy/warmup.py) uses this to lower the
+    kernel ladder with the EXACT shapes a later model load produces;
+    keep it in lock-step with ``__init__``/``_grow`` (and tested
+    against a real bulk_load in tests/test_bench_tools.py)."""
+    cap = max(16, initial_capacity)
+    if n_rows > cap:
+        # one _grow(min_capacity=n_rows) from the fresh store
+        cap = max(cap * 2, n_rows)
+    if cap > _LARGE_ALIGN:
+        cap = -(-cap // _LARGE_ALIGN) * _LARGE_ALIGN
+    return cap
+
+
 def resolve_dtype(name) -> np.dtype:
     """Factor storage dtype from a config string.  ``bfloat16`` halves
     both host and HBM footprint (20M x 250 drops from 20 GB to 10 GB —
@@ -228,6 +245,18 @@ class FeatureVectorStore:
                 self._dirty.add(row)
                 self._free.append(row)
             self._recent.clear()
+
+    def reserve(self, n_rows: int) -> None:
+        """Pre-size the store for ``n_rows`` expected vectors with ONE
+        exact-fit grow — the capacity ``planned_capacity`` predicts and
+        the deploy-time AOT warmup compiled for.  Called at MODEL time
+        with the expected-ID universe, so the per-UP-message replay
+        that follows never regrows (each regrow of a multi-GB store
+        re-uploads the whole device snapshot, and every intermediate
+        pow2 capacity would be a compiled-shape cache miss)."""
+        with self._lock.write():
+            if len(self._row_to_id) < n_rows:
+                self._grow(n_rows)
 
     def _grow(self, min_capacity: int | None = None) -> None:
         old_cap = len(self._row_to_id)

@@ -88,13 +88,23 @@ class ALSServingModelManager(AbstractServingModelManager):
                 model.set_item_vector(id_, vector)
             else:
                 raise ValueError(f"Bad message: {message}")
+            # load-fraction trigger OUTSIDE the log rate limiter: a
+            # bulk replay that finishes inside one 60 s window must
+            # not serve a minute of live traffic without solvers or a
+            # measured kernel route (the `not triggered` bool keeps
+            # the post-trigger per-UP cost at one attribute read)
+            if (not self._triggered_solver
+                    and model.get_fraction_loaded()
+                    >= self.min_model_load_fraction):
+                self._triggered_solver = True
+                model.precompute_solvers()
+                # with the factors loaded, time each eligible kernel
+                # path for the live shape so serving routes by
+                # measured cost (re-measures only if the store's
+                # padded capacity changed since)
+                model.refresh_route()
             if self._log_rate_limit.test():
                 _log.info("%s", model)
-                if (not self._triggered_solver
-                        and model.get_fraction_loaded()
-                        >= self.min_model_load_fraction):
-                    self._triggered_solver = True
-                    model.precompute_solvers()
         elif key in (KEY_MODEL, KEY_MODEL_REF):
             _log.info("Loading new model")
             pmml = read_pmml_from_update_key_message(key, message)
@@ -116,6 +126,11 @@ class ALSServingModelManager(AbstractServingModelManager):
             if self.model is None or features != self.model.features:
                 _log.warning("No previous model, or # features changed; "
                              "creating new one")
+                # a REPLACEMENT model starts un-triggered: the solver
+                # precompute + kernel-route measurement must re-fire at
+                # ITS load-fraction threshold, not stay latched off by
+                # the previous model's trigger
+                self._triggered_solver = False
                 self.model = ALSServingModel(
                     features, implicit, self.sample_rate,
                     self.rescorer_provider, dtype=self.factor_dtype,
@@ -129,6 +144,10 @@ class ALSServingModelManager(AbstractServingModelManager):
             self.model.retain_recent_and_known_items(list(x_ids), list(y_ids))
             self.model.retain_recent_and_user_ids(list(x_ids))
             self.model.retain_recent_and_item_ids(list(y_ids))
+            # hot-swap: the new generation may have regrown the padded
+            # store — refresh the measured-cost kernel route for the
+            # new shape (no-op while capacity and LSH config match)
+            self.model.refresh_route()
             _log.info("Model updated: %s", self.model)
         else:
             raise ValueError(f"Bad key: {key}")

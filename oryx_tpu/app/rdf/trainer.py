@@ -39,6 +39,11 @@ from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
+try:  # moved out of experimental in JAX 0.8
+    from jax import shard_map
+except ImportError:  # pragma: no cover - older JAX
+    from jax.experimental.shard_map import shard_map
+
 import numpy as np
 
 from ...common.rand import RandomManager
@@ -160,7 +165,7 @@ def _dist_histograms_fn(mesh, axis: str, num_slots: int, num_bins: int,
                                 num_slots, num_bins, exact_lowp)
         return jax.lax.psum(local, axis)
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         inner, mesh=mesh,
         in_specs=(P(axis, None), P(axis, None), P(None, axis),
                   P(None, axis)),
@@ -371,7 +376,7 @@ def _dist_slot_counts_fn(mesh, axis: str, num_slots: int):
         local = _slot_counts_body(slot_of, num_slots)
         return jax.lax.psum(local, axis)
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         body, mesh=mesh, in_specs=(P(None, axis),), out_specs=P()))
 
 
@@ -380,7 +385,7 @@ def _dist_advance_fn(mesh, axis: str):
     """Sharded routing step: purely per-sample, no collectives."""
     from jax.sharding import PartitionSpec as P
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         _advance_body, mesh=mesh,
         in_specs=(P(None, axis), P(axis, None)) + (P(),) * 6,
         out_specs=P(None, axis)))

@@ -1,0 +1,200 @@
+"""CI guard: fail when the newest serving grid regresses on sustained
+throughput (ISSUE 3 satellite).
+
+Finds the two most recent ``BENCH_GRID_*.json`` artifacts (by round
+number in the filename), joins their rows on the (features, items,
+lsh) cell key, and exits non-zero when any cell's HEADLINE metric —
+``open_loop_sustained_qps``, the arrival-driven number the grid
+summary leads with — dropped by more than ``--threshold`` (default
+10%).  Closed-loop qps and device_exec_ms are reported alongside for
+diagnosis but do not gate (they are tunnel- and backend-sensitive).
+
+Artifacts from different backends (a CPU smoke grid vs a TPU round)
+are never compared: the guard reports the skip and exits 0 — a silent
+cross-backend "regression" would train people to ignore the gate.
+
+Usage:
+    python -m oryx_tpu.bench.check_regression [--dir .]
+        [--threshold 0.10] [--current F] [--previous F]
+Exit codes: 0 ok/skip, 1 regression, 2 usage/artifact error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+__all__ = ["compare_grids", "find_grid_artifacts", "main"]
+
+_GRID_RE = re.compile(r"BENCH_GRID(?:20M)?_r(\d+)([a-z]?)\.json$")
+
+
+def find_grid_artifacts(directory: str) -> list[str]:
+    """Grid artifact paths sorted oldest-to-newest by (round, suffix)."""
+    found = []
+    for name in os.listdir(directory):
+        m = _GRID_RE.match(name)
+        if m:
+            found.append((int(m.group(1)), m.group(2),
+                          os.path.join(directory, name)))
+    return [p for _, _, p in sorted(found)]
+
+
+def _cells(doc: dict) -> dict:
+    return {(r["features"], r["items"], r["lsh"]): r
+            for r in doc.get("rows", [])}
+
+
+# backend names the TPU-tunnel envelope reports under (plain jax and
+# the remote-plugin stack); legacy artifacts are only comparable to
+# these, never to e.g. a gpu round that merely isn't cpu
+_TPU_BACKENDS = ("tpu", "axon")
+
+
+def backends_comparable(prev_backend, cur_backend) -> bool:
+    """Whether two rounds' qps numbers are a regression signal.  A
+    missing backend field marks a pre-r06 artifact: those rounds
+    (r01-r05) all ran the TPU-tunnel envelope, so they stay comparable
+    to a TPU-backend current round — otherwise the gate would silently
+    skip the very first gated TPU round after this field was
+    introduced.  Every other pairing must match exactly."""
+    if prev_backend == cur_backend:
+        return True
+    return prev_backend is None and cur_backend in _TPU_BACKENDS
+
+
+def compare_grids(prev: dict, cur: dict,
+                  threshold: float = 0.10) -> dict:
+    """Cell-by-cell comparison report; ``report["regressions"]`` is the
+    gating list."""
+    report: dict = {"regressions": [], "improved": [], "ok": [],
+                    "missing_cells": [], "new_cells": [],
+                    "skipped": None}
+    prev_backend = prev.get("backend")
+    cur_backend = cur.get("backend")
+    if not backends_comparable(prev_backend, cur_backend):
+        report["skipped"] = (
+            f"backend mismatch: previous={prev_backend} "
+            f"current={cur_backend} — cross-backend qps is not a "
+            f"regression signal")
+        return report
+    pc, cc = _cells(prev), _cells(cur)
+    report["missing_cells"] = sorted(str(k) for k in pc if k not in cc)
+    report["new_cells"] = sorted(str(k) for k in cc if k not in pc)
+    for key in sorted(k for k in pc if k in cc):
+        p, c = pc[key], cc[key]
+        old = p.get("open_loop_sustained_qps") or 0.0
+        new = c.get("open_loop_sustained_qps") or 0.0
+        cell = {
+            "cell": f"{key[0]}f/{key[1] / 1e6:g}M"
+                    f"{'/lsh' if key[2] else ''}",
+            "sustained_qps_prev": old,
+            "sustained_qps_cur": new,
+            "closed_loop_prev": p.get("qps"),
+            "closed_loop_cur": c.get("qps"),
+            "device_exec_ms_prev": p.get("device_exec_ms"),
+            "device_exec_ms_cur": c.get("device_exec_ms"),
+        }
+        if old <= 0.0:
+            # nothing sustained last round: any measurement is progress
+            report["ok"].append(cell)
+            continue
+        ratio = new / old
+        cell["ratio"] = round(ratio, 3)
+        if ratio < 1.0 - threshold:
+            report["regressions"].append(cell)
+        elif ratio > 1.0 + threshold:
+            report["improved"].append(cell)
+        else:
+            report["ok"].append(cell)
+    return report
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dir", default=".",
+                    help="directory holding BENCH_GRID_*.json rounds")
+    ap.add_argument("--threshold", type=float, default=0.10)
+    ap.add_argument("--current", default=None,
+                    help="explicit current artifact (else newest)")
+    ap.add_argument("--previous", default=None,
+                    help="explicit previous artifact (else second-newest)")
+    args = ap.parse_args(argv)
+
+    def _load(path):
+        with open(path) as f:
+            return json.load(f)
+
+    skipped_rounds: list[str] = []
+    if args.current and args.previous:
+        cur_path, prev_path = args.current, args.previous
+        try:
+            cur, prev = _load(cur_path), _load(prev_path)
+        except (OSError, json.JSONDecodeError) as e:
+            print(json.dumps({"error": f"unreadable artifact: {e}"}))
+            return 2
+    else:
+        arts = find_grid_artifacts(args.dir)
+        if args.current:
+            cur_path = args.current
+            arts = [a for a in arts
+                    if os.path.abspath(a) != os.path.abspath(cur_path)]
+        elif arts:
+            cur_path = arts.pop()
+        else:
+            print(json.dumps({"error": "no BENCH_GRID_*.json found"}))
+            return 2
+        try:
+            cur = _load(cur_path)
+        except (OSError, json.JSONDecodeError) as e:
+            print(json.dumps({"error": f"unreadable artifact: {e}"}))
+            return 2
+        if args.previous:
+            prev_path = args.previous
+            try:
+                prev = _load(prev_path)
+            except (OSError, json.JSONDecodeError) as e:
+                print(json.dumps({"error": f"unreadable artifact: {e}"}))
+                return 2
+        else:
+            # walk back to the NEWEST artifact on the same backend: a
+            # CPU smoke round committed between two TPU rounds must not
+            # un-gate the TPU sequence (the TPU r07 compares against
+            # TPU r05, skipping the cpu r06 in between)
+            prev_path = prev = None
+            for cand in reversed(arts):
+                try:
+                    doc = _load(cand)
+                except (OSError, json.JSONDecodeError):
+                    skipped_rounds.append(os.path.basename(cand))
+                    continue
+                if backends_comparable(doc.get("backend"),
+                                       cur.get("backend")):
+                    prev_path, prev = cand, doc
+                    break
+                skipped_rounds.append(os.path.basename(cand))
+            if prev is None:
+                print(json.dumps({
+                    "skipped": "no prior grid round on backend "
+                               f"{cur.get('backend')!r}",
+                    "skipped_rounds": skipped_rounds,
+                    "current": os.path.basename(cur_path)}))
+                return 0
+    report = compare_grids(prev, cur, threshold=args.threshold)
+    report["previous"] = os.path.basename(prev_path)
+    report["current"] = os.path.basename(cur_path)
+    report["threshold"] = args.threshold
+    if skipped_rounds:
+        # rounds between current and the chosen base that were not
+        # comparable (other backend / unreadable) — visible so a gap in
+        # the gated sequence is never silent
+        report["skipped_rounds"] = skipped_rounds
+    print(json.dumps(report, indent=1))
+    return 1 if report["regressions"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -150,6 +150,36 @@ def _cmd_kafka_input(args) -> int:
     return 0
 
 
+def _cmd_warmup(args) -> int:
+    """AOT-compile the serving kernel shape ladder (and optionally one
+    training iteration's programs) into the persistent XLA cache, so
+    the FIRST-ever layer start on this machine pays cache loads instead
+    of a multi-minute compile (deploy/warmup.py; the install-time
+    answer to the JVM reference's zero first-run tax)."""
+    import json
+
+    from .warmup import run_warmup
+    config = _load_config(args.conf)
+    items_list = [round(float(x) * 1e6) if "." in x or float(x) < 1000
+                  else int(x) for x in args.items.split(",") if x]
+    # default dtype ladder = the DEPLOYMENT'S factor dtype: warming a
+    # dtype the serving layer will never load is paid compile time
+    # with zero first-start benefit
+    dtypes = [d.strip() for d in args.dtypes.split(",") if d.strip()] \
+        if args.dtypes else [config.get_string("oryx.als.factor-dtype")]
+    report = run_warmup(
+        config,
+        items_list=items_list,
+        features_list=[int(x) for x in args.features.split(",") if x],
+        dtypes=dtypes,
+        how_many=args.how_many,
+        train_ratings=args.train_ratings,
+        train_rank=args.train_rank)
+    print(json.dumps(report if args.verbose else {
+        k: v for k, v in report.items() if k not in ("compiled",)}))
+    return 1 if report["compiled_count"] == 0 else 0
+
+
 def _cmd_config_to_properties(args) -> int:
     """Print the resolved ``oryx.*`` configuration as sorted
     ``key=value`` .properties lines on stdout, for shell consumption —
@@ -176,6 +206,10 @@ def main(argv: list[str] | None = None) -> int:
             ("kafka-setup", _cmd_kafka_setup, "create/check topics"),
             ("kafka-tail", _cmd_kafka_tail, "print topic traffic"),
             ("kafka-input", _cmd_kafka_input, "send lines to input topic"),
+            ("warmup", _cmd_warmup,
+             "AOT-compile the serving kernel ladder into the "
+             "persistent XLA cache (install-time, kills the first-run "
+             "compile tax)"),
             ("config-to-properties", _cmd_config_to_properties,
              "print resolved oryx.* config as key=value lines")]:
         p = sub.add_parser(name, help=help_)
@@ -187,6 +221,26 @@ def main(argv: list[str] | None = None) -> int:
         if name == "kafka-input":
             p.add_argument("--file", help="read lines from a file "
                                           "instead of stdin")
+        if name == "warmup":
+            p.add_argument("--items", default="1,5,20",
+                           help="comma list of item counts; values "
+                                "under 1000 mean millions (default "
+                                "the published envelope 1,5,20)")
+            p.add_argument("--features", default="50,250",
+                           help="comma list of feature ranks")
+            p.add_argument("--dtypes", default=None,
+                           help="comma list of factor dtypes to warm "
+                                "(default: the config's "
+                                "oryx.als.factor-dtype)")
+            p.add_argument("--how-many", type=int, default=10)
+            p.add_argument("--train-ratings", type=int, default=0,
+                           help="also run ONE real training iteration "
+                                "at this rating count to seed the "
+                                "trainer's compiled programs")
+            p.add_argument("--train-rank", type=int, default=0)
+            p.add_argument("--verbose", action="store_true",
+                           help="include the full per-kernel compile "
+                                "list in the report")
 
     args = parser.parse_args(argv)
     logging.basicConfig(

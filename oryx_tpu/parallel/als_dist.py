@@ -33,6 +33,11 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+try:  # moved out of experimental in JAX 0.8
+    from jax import shard_map
+except ImportError:  # pragma: no cover - older JAX
+    from jax.experimental.shard_map import shard_map
+
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -127,7 +132,7 @@ def make_train_step(mesh: Mesh, lam: float, alpha: float, implicit: bool,
         return X, Y
 
     spec = P(axis)
-    sharded = jax.shard_map(
+    sharded = shard_map(
         _step, mesh=mesh,
         in_specs=(spec,) * 8,
         out_specs=(spec, spec))

@@ -26,6 +26,11 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+try:  # moved out of experimental in JAX 0.8
+    from jax import shard_map
+except ImportError:  # pragma: no cover - older JAX
+    from jax.experimental.shard_map import shard_map
+
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -71,7 +76,7 @@ def make_lloyd_step(mesh: Mesh, k: int, iterations: int, axis: str = "d"):
                                       length=iterations)
         return centers, costs[-1]
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         _run, mesh=mesh,
         in_specs=(P(axis), P(axis), P()),
         out_specs=(P(), P()))

@@ -1008,8 +1008,13 @@ def test_int8_selection_dispatch_path():
         sm._PALLAS_STATE.update(old_state)
         (sm._FLAT_SCORES_LIMIT, sm._MAX_CHUNK_ROWS,
          sm._BLOCK_KSEL, sm._PA_TILE) = old_limits
-    # default-constructed models keep the measured default (off)
-    assert not ALSServingModel(features=6, implicit=True)._int8_enabled()
+    # default-constructed models get the f<=64 auto default (ON — the
+    # int8+fold mirror is the roofline lever at small F; ISSUE 3)
+    assert ALSServingModel(features=6, implicit=True)._int8_enabled()
+    # ... but auto stays off in the 64 < f < 128 wash zone, and at
+    # unpadded widths where there is no byte tax to reclaim
+    assert not ALSServingModel(features=100, implicit=True)._int8_enabled()
+    assert not ALSServingModel(features=128, implicit=True)._int8_enabled()
 
 
 def test_int8_certificate_passes_on_zero_padded_rows():

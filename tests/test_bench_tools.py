@@ -1,0 +1,197 @@
+"""Tier-1 coverage for the ISSUE 3 bench tooling: the grid-regression
+CI guard (bench/check_regression.py), a small-shape roofline-probe
+invocation, and the AOT warmup's shape planning — all CPU-cheap."""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from oryx_tpu.bench import check_regression as cr
+
+
+def _grid_doc(cells, backend="tpu"):
+    return {"metric": "als_recommend_http_grid", "backend": backend,
+            "rows": [{"features": f, "items": i, "lsh": lsh,
+                      "open_loop_sustained_qps": qps, "qps": qps * 1.2,
+                      "device_exec_ms": 10.0}
+                     for (f, i, lsh, qps) in cells]}
+
+
+def _write(tmp_path, name, doc):
+    p = tmp_path / name
+    p.write_text(json.dumps(doc))
+    return str(p)
+
+
+def test_check_regression_passes_within_threshold(tmp_path, capsys):
+    prev = _grid_doc([(50, 10**6, False, 100.0), (50, 10**6, True, 200.0)])
+    cur = _grid_doc([(50, 10**6, False, 95.0), (50, 10**6, True, 260.0)])
+    rc = cr.main(["--previous", _write(tmp_path, "BENCH_GRID_r05.json", prev),
+                  "--current", _write(tmp_path, "BENCH_GRID_r06.json", cur)])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out)
+    assert not report["regressions"]
+    assert len(report["improved"]) == 1
+
+
+def test_check_regression_fails_on_over_10pct_drop(tmp_path, capsys):
+    prev = _grid_doc([(50, 10**6, False, 100.0), (250, 10**6, False, 50.0)])
+    cur = _grid_doc([(50, 10**6, False, 89.0), (250, 10**6, False, 50.0)])
+    rc = cr.main(["--previous", _write(tmp_path, "BENCH_GRID_r05.json", prev),
+                  "--current", _write(tmp_path, "BENCH_GRID_r06.json", cur)])
+    assert rc == 1
+    report = json.loads(capsys.readouterr().out)
+    assert len(report["regressions"]) == 1
+    assert report["regressions"][0]["cell"] == "50f/1M"
+
+
+def test_check_regression_skips_cross_backend(tmp_path, capsys):
+    prev = _grid_doc([(50, 10**6, False, 100.0)], backend="tpu")
+    cur = _grid_doc([(50, 10**6, False, 1.0)], backend="cpu")
+    rc = cr.main(["--previous", _write(tmp_path, "BENCH_GRID_r05.json", prev),
+                  "--current", _write(tmp_path, "BENCH_GRID_r06.json", cur)])
+    assert rc == 0
+    assert "backend mismatch" in json.loads(capsys.readouterr().out)["skipped"]
+
+
+def test_check_regression_discovers_newest_rounds(tmp_path, capsys):
+    _write(tmp_path, "BENCH_GRID_r04.json",
+           _grid_doc([(50, 10**6, False, 500.0)]))
+    _write(tmp_path, "BENCH_GRID_r05.json",
+           _grid_doc([(50, 10**6, False, 100.0)]))
+    _write(tmp_path, "BENCH_GRID_r06.json",
+           _grid_doc([(50, 10**6, False, 50.0)]))
+    # newest (r06) vs prior (r05): the r04 value must NOT be the base
+    rc = cr.main(["--dir", str(tmp_path)])
+    assert rc == 1
+    report = json.loads(capsys.readouterr().out)
+    assert report["previous"] == "BENCH_GRID_r05.json"
+    assert report["current"] == "BENCH_GRID_r06.json"
+    # zero-sustained previous cells never divide by zero
+    _write(tmp_path, "BENCH_GRID_r07.json",
+           _grid_doc([(50, 10**6, False, 0.0)]))
+    _write(tmp_path, "BENCH_GRID_r08.json",
+           _grid_doc([(50, 10**6, False, 10.0)]))
+    assert cr.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_check_regression_walks_back_to_same_backend_round(tmp_path,
+                                                           capsys):
+    """A CPU smoke round committed between two TPU rounds must not
+    un-gate the TPU sequence: r07 (tpu) compares against r05 (tpu),
+    skipping the cpu r06 — and a >10% drop across that gap still
+    fails."""
+    _write(tmp_path, "BENCH_GRID_r05.json",
+           _grid_doc([(50, 10**6, False, 100.0)], backend="tpu"))
+    _write(tmp_path, "BENCH_GRID_r06.json",
+           _grid_doc([(50, 10**6, False, 1.0)], backend="cpu"))
+    _write(tmp_path, "BENCH_GRID_r07.json",
+           _grid_doc([(50, 10**6, False, 80.0)], backend="tpu"))
+    rc = cr.main(["--dir", str(tmp_path)])
+    assert rc == 1
+    report = json.loads(capsys.readouterr().out)
+    assert report["previous"] == "BENCH_GRID_r05.json"
+    assert report["skipped_rounds"] == ["BENCH_GRID_r06.json"]
+    assert len(report["regressions"]) == 1
+    # no same-backend prior round at all -> skip, exit 0
+    _write(tmp_path, "BENCH_GRID_r08.json",
+           _grid_doc([(50, 10**6, False, 5.0)], backend="gpu"))
+    assert cr.main(["--dir", str(tmp_path)]) == 0
+    assert "no prior grid round" in \
+        json.loads(capsys.readouterr().out)["skipped"]
+
+
+def test_check_regression_single_round_is_ok(tmp_path, capsys):
+    _write(tmp_path, "BENCH_GRID_r06.json", _grid_doc([]))
+    assert cr.main(["--dir", str(tmp_path)]) == 0
+    assert "skipped" in json.loads(capsys.readouterr().out)
+
+
+def test_kernel_probe_small_shape_roofline():
+    """Small-shape probe invocation: the roofline decomposition fields
+    the grid publishes must be present and self-consistent on a CPU
+    streaming shape (the tier-1-safe stand-in for the 20M cells)."""
+    from oryx_tpu.app.als import serving_model as sm
+    from oryx_tpu.app.als.serving_model import ALSServingModel
+    from oryx_tpu.bench.kernel_probe import measure_peaks, probe_model
+
+    rng = np.random.default_rng(3)
+    model = ALSServingModel(features=50, implicit=True)
+    n = 8192
+    model.Y.bulk_load([f"i{j}" for j in range(n)],
+                      rng.standard_normal((n, 50)).astype(np.float32))
+    old = (sm._FLAT_SCORES_LIMIT, sm._MAX_CHUNK_ROWS, sm._PA_TILE)
+    sm._FLAT_SCORES_LIMIT = 1
+    sm._MAX_CHUNK_ROWS = 2048
+    sm._PA_TILE = 2048
+    try:
+        peaks = measure_peaks(m=3)
+        assert peaks["hbm_gb_per_s"] is None \
+            or peaks["hbm_gb_per_s"] > 0
+        out = probe_model(model, batch=32, m=3, peaks=peaks)
+    finally:
+        (sm._FLAT_SCORES_LIMIT, sm._MAX_CHUNK_ROWS, sm._PA_TILE) = old
+    assert out["streaming"]
+    tw = out["twophase"]
+    roof = tw.get("roofline")
+    if tw.get("unmeasurable") or roof is None:
+        pytest.skip("timer noise swallowed the m-queue delta")
+    # analytic bytes: the scan build streams the lane-padded store plus
+    # the (B, N) score spill, write+read
+    assert roof["phase_a_bytes"] >= n * 128 * 4
+    assert roof["phase_a_flops"] == 2 * 32 * n * 128
+    if "phase_b_ms" in roof:
+        assert roof["phase_a_ms"] + roof["phase_b_ms"] == pytest.approx(
+            tw["exec_ms"], rel=1e-6)
+
+
+def test_warmup_planned_capacity_matches_bulk_load():
+    """The AOT warmup's shape planning must predict the EXACT padded
+    capacity a real bulk_load produces — a one-row drift would compile
+    a ladder no model load ever hits."""
+    from oryx_tpu.app.als.feature_vectors import (FeatureVectorStore,
+                                                  planned_capacity)
+
+    for n in (1, 16, 17, 40, 1000, 131072, 131073, 400000):
+        store = FeatureVectorStore(8)
+        store.bulk_load([f"i{j}" for j in range(n)],
+                        np.zeros((n, 8), np.float32))
+        assert len(store.row_ids()) == planned_capacity(n), n
+    # ... and for the REAL serving load path: set_expected_ids
+    # pre-sizes via reserve(), so a per-UP-message replay fills the
+    # planned (warmed) capacity in place instead of pow2-regrowing
+    # through shapes the warmup never compiled
+    n = 3000
+    store = FeatureVectorStore(8)
+    store.reserve(n)
+    assert len(store.row_ids()) == planned_capacity(n)
+    for j in range(n):
+        store.set_vector(f"i{j}", np.ones(8, np.float32))
+    assert len(store.row_ids()) == planned_capacity(n)  # no regrow
+
+
+def test_warmup_cli_reports_compiles(tmp_path):
+    """The warmup subcommand compiles a tiny ladder into a fresh cache
+    dir and reports per-kernel outcomes (pallas failures on CPU are
+    recorded, never fatal)."""
+    import os
+    import subprocess
+    import sys
+
+    conf = tmp_path / "w.conf"
+    conf.write_text(
+        'oryx { compile-cache-dir = "%s" }\n' % (tmp_path / "cache"))
+    out = subprocess.run(
+        [sys.executable, "-m", "oryx_tpu", "warmup", "--conf",
+         str(conf), "--items", "0.002", "--features", "8",
+         "--dtypes", "float32"],
+        capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert out.returncode == 0, out.stderr
+    report = json.loads(out.stdout.strip().splitlines()[-1])
+    assert report["metric"] == "aot_warmup"
+    assert report["compiled_count"] > 0
+    assert report["cache_dir"] == str(tmp_path / "cache")

@@ -1,0 +1,362 @@
+"""ISSUE 3 tier-1 coverage: int8 phase-A exactness at the shipped
+f=50 shape (tie and retired-row edges included), the int8+fold mirror,
+and the measured-cost kernel router (LSH auto-fallback under an
+injected cost inflation).
+
+All CPU-runnable: pallas kernels run in interpret mode; the router is
+exercised with the injected-delay fault points it exposes for exactly
+this purpose (kernel_router fires ``route-measure-lsh`` /
+``route-measure-exact`` inside the timed region of each variant).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from oryx_tpu.app.als.serving_model import ALSServingModel
+from oryx_tpu.resilience import faults
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _exact_sets_match(got_s, got_i, want_s, want_i):
+    """Exact-top-N equality that is honest about ties: scores must be
+    bit-identical position-by-position, ids must match wherever the
+    score is untied, and each tied-score group must select the same id
+    SET (lax.top_k breaks ties by index order, which differs between
+    the flat scan's global order and phase B's gathered-block order —
+    either way the returned items all genuinely share the kth score)."""
+    np.testing.assert_array_equal(got_s, want_s)
+    for b in range(got_s.shape[0]):
+        gs, ws = got_s[b], want_s[b]
+        start = 0
+        while start < len(gs):
+            end = start
+            while end < len(gs) and gs[end] == gs[start]:
+                end += 1
+            assert set(got_i[b, start:end].tolist()) == \
+                set(want_i[b, start:end].tolist()), (b, start, end)
+            start = end
+
+
+def _f50_fixture(seed: int, n: int = 4096, b: int = 8):
+    """Lane-padded f=50 item matrix with deliberate tie and retired-row
+    edges: a duplicated head row (guaranteed score tie inside the
+    top-N) and retired rows salted through the head blocks."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    F, W = 50, 128
+    Y = np.zeros((n, W), np.float32)
+    Y[:, :F] = rng.standard_normal((n, F)).astype(np.float32)
+    # tie edge: three identical copies of one strong row, spread across
+    # different 128-row blocks so phase B's gather order differs from
+    # the flat scan's index order
+    strong = (3.0 * rng.standard_normal(F)).astype(np.float32)
+    for idx in (7, 700, 2900):
+        Y[idx, :F] = strong
+    act = np.ones(n, bool)
+    act[5::11] = False          # retired rows, including head blocks
+    act[701] = False            # retired right next to a tie copy
+    Q = np.zeros((b, W), np.float32)
+    Q[:, :F] = rng.standard_normal((b, F)).astype(np.float32)
+    Q[0, :F] = strong / np.linalg.norm(strong)  # aims at the tied rows
+    return jnp.asarray(Y), jnp.asarray(Q), jnp.asarray(act), F, W
+
+
+@pytest.mark.numerics
+def test_int8_certificate_exact_at_f50_ties_and_retired():
+    """int8 phase A + f32 rescore must return exactly the f32 exact
+    top-N at the shipped f=50 shape — including score ties and retired
+    rows — wherever the certificate passes, and retired rows must never
+    appear."""
+    import jax
+    from oryx_tpu.app.als import serving_model as sm
+
+    Y, Q, active, F, W = _f50_fixture(80)
+    n, b = int(Y.shape[0]), int(Q.shape[0])
+    bs, ksel, k = 128, 24, 8
+    y8, sy_b, l1y_b = sm._quantize_items_kernel(Y, bs)
+    pen_i = sm._penalty_kernel_i32(active, bs)
+    old_tile = sm._PA_TILE
+    sm._PA_TILE = 1024
+    try:
+        ts, ti, cert = jax.device_get(sm._batch_top_n_twophase_pallas_i8(
+            Y, y8, sy_b, l1y_b, Q, pen_i, active, None, None,
+            k=k, bs=bs, ksel=ksel, max_bits=0, interpret=True))
+    finally:
+        sm._PA_TILE = old_tile
+    want_s, want_i = jax.device_get(
+        sm._batch_top_n_kernel(Y, Q, active, k))
+    ok = np.asarray(cert)
+    assert ok.sum() >= b - 1, ok  # margin must not mass-fail certs
+    _exact_sets_match(np.asarray(ts)[ok], np.asarray(ti)[ok],
+                      want_s[ok], want_i[ok])
+    retired = set(np.nonzero(~np.asarray(active))[0].tolist())
+    assert not (set(np.asarray(ti)[ok].ravel().tolist()) & retired)
+    # the tie row the query aims at must surface through the int8 path
+    assert {7, 700, 2900} & set(np.asarray(ti)[0, :3].tolist())
+
+
+@pytest.mark.numerics
+def test_int8_fold_certificate_exact_at_f50():
+    """The int8+fold phase A (the deepened mirror that streams ~items x
+    features bytes) must agree with the f32 exact scan at f=50 exactly
+    like the unfolded int8 kernel — the folded integer dot is
+    bit-identical, so bounds, certificates and phase B are shared."""
+    import jax
+    from oryx_tpu.app.als import serving_model as sm
+
+    Y, Q, active, F, W = _f50_fixture(81)
+    bs, ksel, k = 128, 24, 8
+    fold = sm._fold_factor(W, F)
+    assert fold == 2  # 50 <= 64 = 128/2
+    y8, sy_b, l1y_b = sm._quantize_items_kernel(Y, bs)
+    y8f, pen_i_f = sm._fold_items_i8_kernel(y8, active, fold, bs)
+    old_tile = sm._PA_TILE
+    sm._PA_TILE = 1024
+    try:
+        ts, ti, cert = jax.device_get(
+            sm._batch_top_n_twophase_pallas_i8_fold(
+                Y, y8f, sy_b, l1y_b, Q, pen_i_f, active, None, None,
+                None, k=k, bs=bs, ksel=ksel, max_bits=0, fold=fold,
+                interpret=True))
+        # and bit-identical to the UNFOLDED int8 build: same integer
+        # maxima, same bounds, same phase B
+        pen_i = sm._penalty_kernel_i32(active, bs)
+        ts_u, ti_u, cert_u = jax.device_get(
+            sm._batch_top_n_twophase_pallas_i8(
+                Y, y8, sy_b, l1y_b, Q, pen_i, active, None, None,
+                k=k, bs=bs, ksel=ksel, max_bits=0, interpret=True))
+    finally:
+        sm._PA_TILE = old_tile
+    np.testing.assert_array_equal(ts, ts_u)
+    np.testing.assert_array_equal(ti, ti_u)
+    np.testing.assert_array_equal(cert, cert_u)
+    want_s, want_i = jax.device_get(
+        sm._batch_top_n_kernel(Y, Q, active, k))
+    ok = np.asarray(cert)
+    assert ok.sum() >= Q.shape[0] - 1, ok
+    _exact_sets_match(np.asarray(ts)[ok], np.asarray(ti)[ok],
+                      want_s[ok], want_i[ok])
+
+
+def test_int8_fold_lsh_variant_matches_scan_build():
+    """With the Hamming mask fused in, the int8+fold phase A must agree
+    with the lax.scan build's top-k (the LSH candidate-set invariant
+    must not diverge between builds)."""
+    import jax
+    import jax.numpy as jnp
+    from oryx_tpu.app.als import serving_model as sm
+
+    rng = np.random.default_rng(82)
+    N, F, W, B, k, bs, ksel = 4096, 50, 128, 8, 8, 128, 16
+    fold = sm._fold_factor(W, F)
+    Y = np.zeros((N, W), np.float32)
+    Y[:, :F] = rng.standard_normal((N, F)).astype(np.float32)
+    Yj = jnp.asarray(Y)
+    Q = np.zeros((B, W), np.float32)
+    Q[:, :F] = rng.standard_normal((B, F)).astype(np.float32)
+    Qj = jnp.asarray(Q)
+    active = jnp.asarray(np.ones(N, bool))
+    bkt = jnp.asarray(rng.integers(0, 8, N).astype(np.int32))
+    hp = jnp.asarray(rng.standard_normal((3, W)).astype(np.float32))
+    y8, sy_b, l1y_b = sm._quantize_items_kernel(Yj, bs)
+    y8f, pen_i_f = sm._fold_items_i8_kernel(y8, active, fold, bs)
+    bkt_f = sm._fold_buckets_kernel(bkt, fold, bs)
+    old_tile = sm._PA_TILE
+    sm._PA_TILE = 1024
+    try:
+        ts_f, ti_f, cert_f = jax.device_get(
+            sm._batch_top_n_twophase_pallas_i8_fold(
+                Yj, y8f, sy_b, l1y_b, Qj, pen_i_f, active, bkt_f, bkt,
+                hp, k=k, bs=bs, ksel=ksel, max_bits=1, fold=fold,
+                interpret=True))
+    finally:
+        sm._PA_TILE = old_tile
+    ts_s, ti_s, cert_s = jax.device_get(
+        sm._batch_top_n_twophase_kernel(
+            Yj, Qj, active, bkt, hp, k, 2048, bs, ksel, 1))
+    ok = np.asarray(cert_f) & np.asarray(cert_s)
+    assert ok.sum() >= B - 2
+    np.testing.assert_allclose(np.asarray(ts_f)[ok],
+                               np.asarray(ts_s)[ok], rtol=1e-5)
+    np.testing.assert_array_equal(np.asarray(ti_f)[ok],
+                                  np.asarray(ti_s)[ok])
+
+
+def _small_lsh_model(n=2048, features=10, seed=90):
+    rng = np.random.default_rng(seed)
+    model = ALSServingModel(features=features, implicit=True,
+                            sample_rate=0.3)
+    assert model._lsh_active()
+    model.Y.bulk_load([f"i{j}" for j in range(n)],
+                      rng.standard_normal((n, features)).astype(
+                          np.float32))
+    model.X.bulk_load(["u0"],
+                      rng.standard_normal((1, features)).astype(
+                          np.float32))
+    return model
+
+
+def test_router_falls_back_to_exact_when_lsh_cost_inflated():
+    """ISSUE 3 satellite: when a fault point inflates the measured LSH
+    cost, the router must route LSH-configured queries to the exact
+    scan — and the served results must BE the exact results."""
+    model = _small_lsh_model()
+    n_rows = len(model.Y.row_ids())
+    faults.inject("route-measure-lsh", mode="delay", times=None,
+                  delay_sec=0.05)
+    route = model.refresh_route(force=True)
+    assert faults.fired("route-measure-lsh") > 0
+    assert route["measured"] and route["use_lsh"] is False
+    assert model._route_use_lsh(n_rows) is False
+    # LSH-configured batched queries now serve the exact scan
+    rng = np.random.default_rng(91)
+    q = rng.standard_normal((3, model.features)).astype(np.float32)
+    got = model.top_n_batch(5, q, use_lsh=True)
+    want = model.top_n_batch(5, q, use_lsh=False)
+    assert got == want
+    # /metrics exposes the decision and the measured costs
+    m = model.metrics()
+    assert m["kernel_route"]["use_lsh"] is False
+    assert m["kernel_route"]["costs_lsh_ms"]
+    assert m["kernel_route"]["costs_exact_ms"]
+
+
+def test_router_honors_lsh_when_it_measures_faster():
+    """Config semantics are preserved where LSH wins: inflate the EXACT
+    side instead and the router keeps the Hamming mask."""
+    model = _small_lsh_model(seed=92)
+    n_rows = len(model.Y.row_ids())
+    faults.inject("route-measure-exact", mode="delay", times=None,
+                  delay_sec=0.05)
+    route = model.refresh_route(force=True)
+    assert faults.fired("route-measure-exact") > 0
+    assert route["use_lsh"] is True
+    assert model._route_use_lsh(n_rows) is True
+
+
+def test_router_streaming_orders_kinds_and_survives_pallas_fallback():
+    """On the CPU streaming path every pallas build fails to lower; the
+    router must still measure the lax.scan build, install a route, and
+    leave the dispatch chain's static order intact for unmeasured
+    kinds.  A synthetic cost table must reorder the chain strictly by
+    measured cost."""
+    from oryx_tpu.app.als import serving_model as sm
+
+    rng = np.random.default_rng(93)
+    model = ALSServingModel(features=6, implicit=True)
+    model.Y.bulk_load([f"i{j}" for j in range(4096)],
+                      rng.standard_normal((4096, 6)).astype(np.float32))
+    old = (sm._FLAT_SCORES_LIMIT, sm._MAX_CHUNK_ROWS, sm._BLOCK_KSEL,
+           sm._PA_TILE)
+    old_state = dict(sm._PALLAS_STATE)
+    sm._PALLAS_STATE.clear()
+    sm._FLAT_SCORES_LIMIT = 1
+    sm._MAX_CHUNK_ROWS = 1024
+    sm._BLOCK_KSEL = 4
+    sm._PA_TILE = 1024
+    try:
+        route = model.refresh_route(force=True)
+        assert route["path"] == "streaming"
+        # scan measured; pallas builds recorded as unavailable on CPU
+        assert route["costs_exact_ms"].get("scan") is not None
+        assert route["costs_exact_ms"].get("pallas") is None
+        n_rows = len(model.Y.row_ids())
+        # synthetic measured costs reorder the chain cheapest-first
+        model._route = {"measured": True, "lsh_configured": False,
+                        "phase_a_costs_ms": {"pallas": 1.0,
+                                             "fold": 5.0,
+                                             "i8_fold": 3.0}}
+        model._route_capacity = n_rows
+        assert model._route_order(
+            ["i8_fold", "fold", "i8", "pallas"], n_rows) == \
+            ["pallas", "i8_fold", "fold", "i8"]
+        # a stale route (capacity mismatch) leaves the static order
+        assert model._route_order(["fold", "pallas"], n_rows + 1) == \
+            ["fold", "pallas"]
+    finally:
+        sm._PALLAS_STATE.clear()
+        sm._PALLAS_STATE.update(old_state)
+        (sm._FLAT_SCORES_LIMIT, sm._MAX_CHUNK_ROWS, sm._BLOCK_KSEL,
+         sm._PA_TILE) = old
+        model._route = None
+
+
+def test_route_cached_per_capacity_and_refreshed_on_growth():
+    """A route is reused while the padded capacity matches and is NOT
+    consulted after the store regrows (hot-swap semantics)."""
+    model = _small_lsh_model(seed=94)
+    r1 = model.refresh_route()
+    assert r1 is not None
+    assert model.refresh_route() is r1  # cached, no re-measure
+    n_rows = len(model.Y.row_ids())
+    assert model._route_current(n_rows) is r1
+    assert model._route_current(n_rows * 2) is None
+    r2 = model.refresh_route(force=True)
+    assert r2 is not r1
+
+
+def test_router_skips_empty_and_sharded_models():
+    model = ALSServingModel(features=6, implicit=True)
+    assert model.refresh_route() is None
+    assert model._route_use_lsh(0) is True  # no route -> config honored
+
+
+def test_refresh_route_failure_never_escapes(monkeypatch):
+    """Route measurement is advisory: a failure inside measure_routes
+    (device OOM building a mirror, transport error) must not escape
+    refresh_route — an escaped exception on the MODEL consume path
+    would trap the serving update consumer in replay-from-0 against
+    the same deterministic failure."""
+    from oryx_tpu.app.als import kernel_router
+
+    model = _small_lsh_model(seed=95)
+
+    def boom(*_a, **_k):
+        raise RuntimeError("injected measurement failure")
+
+    monkeypatch.setattr(kernel_router, "measure_routes", boom)
+    assert model.refresh_route(force=True) is None  # swallowed
+    # serving continues config-driven: no route installed
+    assert model._route_use_lsh(len(model.Y.row_ids())) is True
+
+
+def test_route_measurement_evicts_losing_mirrors():
+    """Measurement materializes every build's mirror; after routing,
+    only the chosen kind's device arrays may stay pinned (at 20M rows
+    the losers are ~5 GB of HBM next to the store)."""
+    from oryx_tpu.app.als import serving_model as sm
+
+    rng = np.random.default_rng(96)
+    model = ALSServingModel(features=6, implicit=True)
+    model.Y.bulk_load([f"i{j}" for j in range(4096)],
+                      rng.standard_normal((4096, 6)).astype(np.float32))
+    old = (sm._FLAT_SCORES_LIMIT, sm._MAX_CHUNK_ROWS, sm._BLOCK_KSEL,
+           sm._PA_TILE)
+    old_state = dict(sm._PALLAS_STATE)
+    sm._PALLAS_STATE.clear()
+    sm._FLAT_SCORES_LIMIT = 1
+    sm._MAX_CHUNK_ROWS = 1024
+    sm._BLOCK_KSEL = 4
+    sm._PA_TILE = 1024
+    try:
+        route = model.refresh_route(force=True)
+        # CPU routes to the scan build, which needs NO mirror: every
+        # measured-and-lost mirror must be gone
+        assert route["chosen"] == "scan"
+        for attr in ("_i8", "_i8_fold", "_fold", "_fold_bkt",
+                     "_penalty", "_penalty_i"):
+            assert getattr(model, attr) is None, attr
+    finally:
+        sm._PALLAS_STATE.clear()
+        sm._PALLAS_STATE.update(old_state)
+        (sm._FLAT_SCORES_LIMIT, sm._MAX_CHUNK_ROWS, sm._BLOCK_KSEL,
+         sm._PA_TILE) = old

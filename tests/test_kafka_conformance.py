@@ -1,0 +1,250 @@
+"""Spec-derived Kafka wire-protocol conformance fixtures (VERDICT r5
+Missing #1 / ISSUE 3 satellite).
+
+The binding's client (kafka/wire.py) and the broker it is normally
+tested against (kafka/mini_broker.py) are both self-authored, so a
+mirrored protocol misunderstanding would pass every existing test.
+Everything in this file is derived from the protocol specifications
+with the mini-broker OUT of the loop:
+
+- CRC32C check values from RFC 3720 §B.4 (the published iSCSI test
+  vectors for the Castagnoli polynomial Kafka mandates for record
+  batches).
+- Zigzag varint vectors from the Protocol Buffers encoding spec, which
+  the Kafka record format v2 adopts verbatim for record fields.
+- A golden v2 RecordBatch, field-by-field from KIP-98 / the Kafka
+  protocol guide's record-batch layout, with its CRC sealed by an
+  independent bit-by-bit CRC32C implementation (validated against the
+  RFC vectors first) — not by the codec under test.
+- A golden request frame per the RequestHeader v1 layout.
+- Property/fuzz round-trips of the v2 record-batch codec (null/empty
+  keys and values, binary payloads, multi-batch concatenation,
+  truncated tails, control batches, compressed-batch rejection).
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+import pytest
+
+from oryx_tpu.kafka import wire
+
+
+# -- independent CRC32C (NOT the implementation under test) ---------------
+
+def _crc32c_bitwise(data: bytes) -> int:
+    """Bit-by-bit CRC32C: reflected Castagnoli polynomial 0x82F63B78,
+    init/xorout 0xFFFFFFFF — transcribed from the polynomial
+    definition, sharing nothing with wire.crc32c's sliced table."""
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc ^= b
+        for _ in range(8):
+            crc = (crc >> 1) ^ (0x82F63B78 if crc & 1 else 0)
+    return crc ^ 0xFFFFFFFF
+
+
+# RFC 3720 §B.4 published test vectors for CRC32C
+_RFC3720_VECTORS = [
+    (b"", 0x00000000),
+    (b"123456789", 0xE3069283),          # the classic check value
+    (b"\x00" * 32, 0x8A9136AA),
+    (b"\xff" * 32, 0x62A8AB43),
+    (bytes(range(32)), 0x46DD794E),
+]
+
+
+def test_crc32c_matches_rfc3720_vectors():
+    for data, want in _RFC3720_VECTORS:
+        assert wire.crc32c(data) == want, data[:16]
+        # the sealing implementation used for the golden batch below
+        # must itself pass the published vectors
+        assert _crc32c_bitwise(data) == want, data[:16]
+
+
+def test_crc32c_agrees_with_independent_implementation_on_fuzz():
+    rng = np.random.default_rng(7)
+    for n in (1, 2, 3, 7, 64, 255, 1024, 4097):
+        data = rng.integers(0, 256, n, dtype=np.uint8).tobytes()
+        assert wire.crc32c(data) == _crc32c_bitwise(data)
+
+
+# -- zigzag varints (Protocol Buffers encoding spec) ----------------------
+
+# (signed value, zigzag-encoded unsigned) from the protobuf spec table
+_ZIGZAG_VECTORS = [(0, 0), (-1, 1), (1, 2), (-2, 3), (2, 4),
+                   (2147483647, 4294967294), (-2147483648, 4294967295)]
+
+
+def test_zigzag_matches_protobuf_spec_table():
+    for signed, encoded in _ZIGZAG_VECTORS:
+        assert wire._zigzag(signed) & 0xFFFFFFFFFFFFFFFF == encoded
+        assert wire._unzigzag(encoded) == signed
+
+
+def test_varint_wire_bytes_match_spec():
+    # varint(300) per the protobuf spec worked example is AC 02 — for
+    # the unsigned value; Kafka writes zigzag(signed), so signed 150
+    # (zigzag -> 300) must serialize to AC 02
+    buf = bytearray()
+    wire.write_varint(buf, 150)
+    assert bytes(buf) == b"\xac\x02"
+    # single-byte boundary: zigzag(63) = 126 = 0x7E; zigzag(64) = 128
+    # crosses into two bytes 0x80 0x01
+    buf = bytearray()
+    wire.write_varint(buf, 63)
+    assert bytes(buf) == b"\x7e"
+    buf = bytearray()
+    wire.write_varint(buf, 64)
+    assert bytes(buf) == b"\x80\x01"
+    for v in (0, -1, 1, 63, 64, -65, 150, 10**12, -(10**12)):
+        buf = bytearray()
+        wire.write_varint(buf, v)
+        got, off = wire.read_varint(bytes(buf), 0)
+        assert (got, off) == (v, len(buf))
+
+
+# -- golden v2 RecordBatch (KIP-98 layout, sealed independently) ----------
+
+# baseOffset=0, one record key=b"key" value=b"value", timestamps 1000,
+# producer id/epoch/baseSequence -1 (idempotence unused), uncompressed.
+# Layout, field by field (big-endian; varints zigzag):
+#   baseOffset           int64   0
+#   batchLength          int32   64   (partitionLeaderEpoch..end)
+#   partitionLeaderEpoch int32   -1
+#   magic                int8    2
+#   crc                  uint32  0x44C98E4F  = bitwise CRC32C of the
+#                                55 tail bytes (attributes..records)
+#   attributes           int16   0
+#   lastOffsetDelta      int32   0
+#   baseTimestamp        int64   1000
+#   maxTimestamp         int64   1000
+#   producerId           int64   -1
+#   producerEpoch        int16   -1
+#   baseSequence         int32   -1
+#   recordsCount         int32   1
+#   record: length=varint(14)=0x1C, attributes=0, tsDelta=varint(0),
+#           offsetDelta=varint(0), keyLen=varint(3)=0x06, "key",
+#           valueLen=varint(5)=0x0A, "value", headersCount=varint(0)
+_GOLDEN_BATCH = bytes.fromhex(
+    "000000000000000000000040ffffffff0244c98e4f0000000000000000000000"
+    "0003e800000000000003e8ffffffffffffffffffffffffffff000000011c0000"
+    "00066b65790a76616c756500")
+
+
+def test_golden_batch_crc_is_sealed_by_independent_crc32c():
+    tail = _GOLDEN_BATCH[21:]
+    assert len(tail) == 55
+    (crc,) = struct.unpack(">I", _GOLDEN_BATCH[17:21])
+    assert crc == 0x44C98E4F
+    assert _crc32c_bitwise(tail) == crc
+
+
+def test_decoder_parses_spec_golden_batch():
+    got = wire.decode_record_batches(_GOLDEN_BATCH)
+    assert got == [(0, b"key", b"value")]
+
+
+def test_encoder_reproduces_spec_golden_batch_byte_identical():
+    enc = wire.encode_record_batch(0, [(b"key", b"value")],
+                                   timestamp_ms=1000)
+    assert enc == _GOLDEN_BATCH
+
+
+# -- golden request frame (RequestHeader v1) ------------------------------
+
+def test_request_header_frame_matches_spec_layout():
+    """ApiVersions v0 request for client 'oryx-tpu', correlation 1:
+    Size(18) | api_key(18) | api_version(0) | correlation_id(1) |
+    client_id as int16-length-prefixed string — the RequestHeader v1
+    layout from the protocol guide, assembled here by hand."""
+    golden = bytes.fromhex("00000012" "0012" "0000" "00000001"
+                           "0008" + b"oryx-tpu".hex())
+    head = wire.Writer()
+    head.i16(18).i16(0).i32(1)
+    head.string("oryx-tpu")
+    payload = head.getvalue()
+    assert struct.pack("!i", len(payload)) + payload == golden
+
+
+def test_reader_parses_spec_assembled_api_versions_response():
+    """An ApiVersions v0 response body assembled by hand from the spec
+    (error_code, then [api_key min max] array) must parse through the
+    same Reader primitives the client uses."""
+    body = struct.pack(">hih h h", 0, 2, 18, 0, 2) \
+        + struct.pack(">hhh", 3, 0, 9)
+    r = wire.Reader(body)
+    assert r.i16() == 0
+    rows = r.array(lambda rr: (rr.i16(), rr.i16(), rr.i16()))
+    assert rows == [(18, 0, 2), (3, 0, 9)]
+    assert r.remaining() == 0
+
+
+# -- property / fuzz round-trips ------------------------------------------
+
+def _random_records(rng, n):
+    out = []
+    for _ in range(n):
+        key = None if rng.random() < 0.25 else \
+            rng.integers(0, 256, int(rng.integers(0, 40)),
+                         dtype=np.uint8).tobytes()
+        value = None if rng.random() < 0.1 else \
+            rng.integers(0, 256, int(rng.integers(0, 300)),
+                         dtype=np.uint8).tobytes()
+        out.append((key, value))
+    return out
+
+
+def test_record_batch_roundtrip_fuzz():
+    rng = np.random.default_rng(11)
+    for trial in range(40):
+        base = int(rng.integers(0, 2**40))
+        recs = _random_records(rng, int(rng.integers(1, 20)))
+        ts = int(rng.integers(0, 2**41))
+        enc = wire.encode_record_batch(base, recs, timestamp_ms=ts)
+        # frame invariants straight from the spec
+        (base_off, batch_len) = struct.unpack_from(">qi", enc, 0)
+        assert base_off == base and batch_len == len(enc) - 12
+        assert enc[16] == 2  # magic
+        (crc,) = struct.unpack_from(">I", enc, 17)
+        assert crc == _crc32c_bitwise(enc[21:])
+        got = wire.decode_record_batches(enc)
+        assert got == [(base + i, k, v)
+                       for i, (k, v) in enumerate(recs)]
+
+
+def test_multi_batch_concatenation_and_truncated_tail():
+    rng = np.random.default_rng(13)
+    batches, want, off = [], [], 5
+    for _ in range(4):
+        recs = _random_records(rng, int(rng.integers(1, 8)))
+        batches.append(wire.encode_record_batch(off, recs))
+        want += [(off + i, k, v) for i, (k, v) in enumerate(recs)]
+        off += len(recs)
+    blob = b"".join(batches)
+    assert wire.decode_record_batches(blob) == want
+    # a broker may cut the stream at max_bytes mid-batch: every prefix
+    # must decode to a prefix of the full record list, never raise
+    for cut in range(len(blob)):
+        got = wire.decode_record_batches(blob[:cut])
+        assert got == want[:len(got)]
+
+
+def test_control_batch_skipped_and_compressed_rejected():
+    data = bytearray(wire.encode_record_batch(0, [(b"k", b"v")]))
+    # attributes live right after the crc (offset 21); bit 5 = control
+    control = bytearray(data)
+    control[22] |= 0x20
+    struct.pack_into(">I", control, 17,
+                     _crc32c_bitwise(bytes(control[21:])))
+    follow = wire.encode_record_batch(1, [(b"k2", b"v2")])
+    assert wire.decode_record_batches(bytes(control) + follow) == \
+        [(1, b"k2", b"v2")]
+    compressed = bytearray(data)
+    compressed[22] |= 0x01  # gzip codec bits
+    struct.pack_into(">I", compressed, 17,
+                     _crc32c_bitwise(bytes(compressed[21:])))
+    with pytest.raises(wire.KafkaProtocolError):
+        wire.decode_record_batches(bytes(compressed))
