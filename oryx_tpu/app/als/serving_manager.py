@@ -13,6 +13,8 @@ from __future__ import annotations
 import logging
 
 from ...api.serving import AbstractServingModelManager
+from ...cluster.membership import KEY_HEARTBEAT
+from ...cluster.sharding import is_local_item, parse_shard_spec
 from ...common import pmml as pmml_io
 from ...common.config import Config
 from ...common.lang import RateLimitCheck
@@ -63,6 +65,27 @@ class ALSServingModelManager(AbstractServingModelManager):
         # refused instead of absorbing into the serving model
         self.rejected_updates = 0
         self.rejected_models = 0
+        # -- serving-cluster state (oryx_tpu/cluster/) -------------------
+        # catalog shard this replica materializes: Y vectors whose id
+        # hashes elsewhere are skipped (the user store and known-items
+        # stay FULL — they are needed for local exclusion and are tiny
+        # next to the item matrix).  "0/1" = the whole catalog, i.e.
+        # plain single-node serving.
+        spec = (config.get_optional_string("oryx.cluster.shard")
+                if config.get_bool("oryx.cluster.enabled") else None)
+        self.shard_index, self.shard_count = parse_shard_spec(spec or "0/1")
+        # accepted MODEL/MODEL-REF documents since replay offset 0 —
+        # the replica's model GENERATION, identical across replicas
+        # (the update topic is totally ordered), carried in heartbeats
+        # so the router never routes to a replica serving older state
+        self.generation = 0
+        # item id -> first-appearance index in the Y update stream: the
+        # cluster's canonical tie-break ordinal (cluster/merge.py),
+        # identical on every replica for the same topic replay.
+        # Counts EVERY Y id seen, including ones this shard skips.
+        self.item_ordinals: dict[str, int] = {}
+        # Y vectors skipped as non-local (observability)
+        self.skipped_remote_items = 0
 
     def get_model(self) -> ALSServingModel | None:
         return self.model
@@ -85,7 +108,15 @@ class ALSServingModelManager(AbstractServingModelManager):
                 if extras is not None:
                     model.add_known_items(id_, [str(i) for i in extras])
             elif kind == "Y":
-                model.set_item_vector(id_, vector)
+                # ordinal BEFORE the shard filter: the canonical
+                # tie-break must agree across replicas that each skip
+                # different ids
+                self.item_ordinals.setdefault(id_,
+                                              len(self.item_ordinals))
+                if is_local_item(id_, self.shard_index, self.shard_count):
+                    model.set_item_vector(id_, vector)
+                else:
+                    self.skipped_remote_items += 1
             else:
                 raise ValueError(f"Bad message: {message}")
             # load-fraction trigger OUTSIDE the log rate limiter: a
@@ -140,14 +171,29 @@ class ALSServingModelManager(AbstractServingModelManager):
             _log.info("Updating model")
             x_ids = set(pmml_io.get_extension_content(pmml, "XIDs") or [])
             y_ids = set(pmml_io.get_extension_content(pmml, "YIDs") or [])
-            self.model.set_expected_ids(list(x_ids), list(y_ids))
+            # sharded replica: expected-ID accounting and the Y retain
+            # run over the LOCAL slice only (fraction-loaded gates on
+            # what this shard will actually materialize); known-items
+            # retain keeps the GLOBAL id universe — exclusion works by
+            # id and must cover items other shards hold
+            local_y = [i for i in y_ids
+                       if is_local_item(i, self.shard_index,
+                                        self.shard_count)] \
+                if self.shard_count > 1 else list(y_ids)
+            self.model.set_expected_ids(list(x_ids), local_y)
             self.model.retain_recent_and_known_items(list(x_ids), list(y_ids))
             self.model.retain_recent_and_user_ids(list(x_ids))
-            self.model.retain_recent_and_item_ids(list(y_ids))
+            self.model.retain_recent_and_item_ids(local_y)
+            self.generation += 1
             # hot-swap: the new generation may have regrown the padded
             # store — refresh the measured-cost kernel route for the
             # new shape (no-op while capacity and LSH config match)
             self.model.refresh_route()
             _log.info("Model updated: %s", self.model)
+        elif key == KEY_HEARTBEAT:
+            # cluster control-plane traffic on the shared update topic;
+            # the layers' consume threads already filter it, this guard
+            # covers direct manager drives (tests, embedding)
+            return
         else:
             raise ValueError(f"Bad key: {key}")

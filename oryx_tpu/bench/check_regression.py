@@ -1,21 +1,28 @@
-"""CI guard: fail when the newest serving grid regresses on sustained
-throughput (ISSUE 3 satellite).
+"""CI guard: fail when the newest serving bench round regresses on
+sustained throughput (ISSUE 3 satellite; gateway cells ISSUE 4).
 
-Finds the two most recent ``BENCH_GRID_*.json`` artifacts (by round
-number in the filename), joins their rows on the (features, items,
-lsh) cell key, and exits non-zero when any cell's HEADLINE metric —
-``open_loop_sustained_qps``, the arrival-driven number the grid
-summary leads with — dropped by more than ``--threshold`` (default
-10%).  Closed-loop qps and device_exec_ms are reported alongside for
+Two artifact families share the machinery, selected by ``--kind``:
+
+- ``grid`` (default): ``BENCH_GRID_*.json``, cells keyed by
+  (features, items, lsh) — the single-node serving envelope.
+- ``gateway``: ``BENCH_GATEWAY_*.json``, cells keyed by
+  (features, items, replicas) — the scatter-gather cluster's
+  per-replica-count scaling rounds.
+
+Joins the two most recent rounds (by round number in the filename) on
+the cell key and exits non-zero when any cell's HEADLINE metric —
+``open_loop_sustained_qps``, the arrival-driven number the summaries
+lead with — dropped by more than ``--threshold`` (default 10%).
+Closed-loop qps and device_exec_ms are reported alongside for
 diagnosis but do not gate (they are tunnel- and backend-sensitive).
 
-Artifacts from different backends (a CPU smoke grid vs a TPU round)
+Artifacts from different backends (a CPU smoke round vs a TPU round)
 are never compared: the guard reports the skip and exits 0 — a silent
 cross-backend "regression" would train people to ignore the gate.
 
 Usage:
-    python -m oryx_tpu.bench.check_regression [--dir .]
-        [--threshold 0.10] [--current F] [--previous F]
+    python -m oryx_tpu.bench.check_regression [--kind grid|gateway]
+        [--dir .] [--threshold 0.10] [--current F] [--previous F]
 Exit codes: 0 ok/skip, 1 regression, 2 usage/artifact error.
 """
 
@@ -27,25 +34,46 @@ import os
 import re
 import sys
 
-__all__ = ["compare_grids", "find_grid_artifacts", "main"]
+__all__ = ["compare_grids", "find_grid_artifacts",
+           "find_gateway_artifacts", "main"]
 
 _GRID_RE = re.compile(r"BENCH_GRID(?:20M)?_r(\d+)([a-z]?)\.json$")
+_GATEWAY_RE = re.compile(r"BENCH_GATEWAY_r(\d+)([a-z]?)\.json$")
 
 
-def find_grid_artifacts(directory: str) -> list[str]:
-    """Grid artifact paths sorted oldest-to-newest by (round, suffix)."""
+def _find_artifacts(directory: str, pattern: re.Pattern) -> list[str]:
     found = []
     for name in os.listdir(directory):
-        m = _GRID_RE.match(name)
+        m = pattern.match(name)
         if m:
             found.append((int(m.group(1)), m.group(2),
                           os.path.join(directory, name)))
     return [p for _, _, p in sorted(found)]
 
 
+def find_grid_artifacts(directory: str) -> list[str]:
+    """Grid artifact paths sorted oldest-to-newest by (round, suffix)."""
+    return _find_artifacts(directory, _GRID_RE)
+
+
+def find_gateway_artifacts(directory: str) -> list[str]:
+    return _find_artifacts(directory, _GATEWAY_RE)
+
+
 def _cells(doc: dict) -> dict:
+    if doc.get("metric") == "gateway_recommend_scaling":
+        # per-replica-count scaling cells (bench/gateway.py)
+        return {(r["features"], r["items"], r["replicas"]): r
+                for r in doc.get("rows", [])}
     return {(r["features"], r["items"], r["lsh"]): r
             for r in doc.get("rows", [])}
+
+
+def _cell_label(doc: dict, key: tuple) -> str:
+    if doc.get("metric") == "gateway_recommend_scaling":
+        return (f"{key[0]}f/{key[1] / 1e6:g}M/"
+                f"{key[2]}rep")
+    return f"{key[0]}f/{key[1] / 1e6:g}M{'/lsh' if key[2] else ''}"
 
 
 # backend names the TPU-tunnel envelope reports under (plain jax and
@@ -89,8 +117,7 @@ def compare_grids(prev: dict, cur: dict,
         old = p.get("open_loop_sustained_qps") or 0.0
         new = c.get("open_loop_sustained_qps") or 0.0
         cell = {
-            "cell": f"{key[0]}f/{key[1] / 1e6:g}M"
-                    f"{'/lsh' if key[2] else ''}",
+            "cell": _cell_label(cur, key),
             "sustained_qps_prev": old,
             "sustained_qps_cur": new,
             "closed_loop_prev": p.get("qps"),
@@ -115,8 +142,12 @@ def compare_grids(prev: dict, cur: dict,
 
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser()
+    ap.add_argument("--kind", choices=("grid", "gateway"),
+                    default="grid",
+                    help="artifact family: single-node serving grid or "
+                         "the cluster gateway's per-replica scaling")
     ap.add_argument("--dir", default=".",
-                    help="directory holding BENCH_GRID_*.json rounds")
+                    help="directory holding BENCH_*_r*.json rounds")
     ap.add_argument("--threshold", type=float, default=0.10)
     ap.add_argument("--current", default=None,
                     help="explicit current artifact (else newest)")
@@ -137,7 +168,9 @@ def main(argv: list[str] | None = None) -> int:
             print(json.dumps({"error": f"unreadable artifact: {e}"}))
             return 2
     else:
-        arts = find_grid_artifacts(args.dir)
+        arts = (find_gateway_artifacts(args.dir)
+                if args.kind == "gateway"
+                else find_grid_artifacts(args.dir))
         if args.current:
             cur_path = args.current
             arts = [a for a in arts
@@ -145,7 +178,8 @@ def main(argv: list[str] | None = None) -> int:
         elif arts:
             cur_path = arts.pop()
         else:
-            print(json.dumps({"error": "no BENCH_GRID_*.json found"}))
+            kind = "GATEWAY" if args.kind == "gateway" else "GRID"
+            print(json.dumps({"error": f"no BENCH_{kind}_*.json found"}))
             return 2
         try:
             cur = _load(cur_path)

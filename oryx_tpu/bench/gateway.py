@@ -1,0 +1,414 @@
+"""Gateway scale-out benchmark: sustained /recommend qps through the
+scatter-gather router at 1 -> 2 -> 4 catalog-shard replicas.
+
+The cluster is real processes (``python -m oryx_tpu serving --shard
+i/N`` + ``router``) over a durable ``file://`` broker, so the scaling
+measured is actual OS-level parallelism, not threads behind one GIL.
+Every replica is pinned to ONE XLA host compute thread
+(``--replica-threads``) — fixed per-replica hardware on a shared box.
+
+On accelerator-backed (or many-core) hosts, run with real scans: each
+replica's device scans its slice and sharding scales throughput
+directly.  On a small shared-CPU host the co-located "device" IS the
+host cores — a 1-replica baseline already saturates them, and adding
+replicas re-divides the same silicon (anti-scaling that measures the
+scheduler, not the gateway).  There ``--device-ms-per-mrow`` emulates
+fixed-rate per-replica accelerators: every scoring dispatch sleeps
+for the time a device streaming the replica's slice would take (time
+∝ rows — the measured phase-A roofline shape), staged through the
+``serving-scan-dispatch`` fault point, burning no host CPU.  The
+artifact records the emulation constant; the regression gate compares
+like cells only.
+
+The harness publishes one synthetic model stream to the update topic
+(MODEL + per-row UP messages — the exact replay path production
+replicas consume), and per replica count waits for the router to
+report full shard coverage, spot-checks router answers against a
+direct replica merge, then walks an open-loop rate ladder
+(bench/load.py's arrival-scheduled driver) to the highest sustained
+rate.
+
+Writes ``BENCH_GATEWAY_r07.json``; ``bench/check_regression.py
+--kind gateway`` gates successive rounds per (features, items,
+replicas) cell.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+import numpy as np
+
+from ..common import pmml as pmml_io
+from ..common.config import keys_to_hocon
+from ..kafka.api import KEY_MODEL, KEY_UP
+from ..kafka.inproc import resolve_broker
+from .load import run_recommend_open_loop
+
+__all__ = ["run_cell", "main"]
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _publish_model(broker_dir: str, users: int, items: int,
+                   features: int, seed: int = 5) -> list[str]:
+    """MODEL + UP replay onto the file broker — the same stream a
+    batch generation publishes, so replicas load through the real
+    consume path.  Writes the single-partition topic log directly in
+    the broker's JSONL format (``[key, message]`` per line): the
+    broker's per-record append re-reads its own write for multi-writer
+    offset agreement, a tax a one-shot half-gigabyte publish need not
+    pay.  A post-write ``resolve_broker`` sanity read keeps the layout
+    honest."""
+    rng = np.random.default_rng(seed)
+    os.makedirs(broker_dir, exist_ok=True)
+    user_ids = [f"u{j}" for j in range(users)]
+    item_ids = [f"i{j}" for j in range(items)]
+    doc = pmml_io.build_skeleton_pmml()
+    pmml_io.add_extension(doc, "features", features)
+    pmml_io.add_extension(doc, "implicit", True)
+    pmml_io.add_extension_content(doc, "XIDs", user_ids)
+    pmml_io.add_extension_content(doc, "YIDs", item_ids)
+    with open(os.path.join(broker_dir, "GwUp.topic.jsonl"), "a",
+              encoding="utf-8", buffering=1 << 20) as f:
+        f.write(json.dumps([KEY_MODEL, pmml_io.to_string(doc)]) + "\n")
+        y = rng.standard_normal((items, features)).astype(np.float32)
+        for iid, row in zip(item_ids, np.round(y, 4).tolist()):
+            f.write(json.dumps(
+                [KEY_UP, json.dumps(["Y", iid, row])]) + "\n")
+        x = rng.standard_normal((users, features)).astype(np.float32)
+        for uid, row in zip(user_ids, np.round(x, 4).tolist()):
+            f.write(json.dumps(
+                [KEY_UP, json.dumps(["X", uid, row, []])]) + "\n")
+    broker = resolve_broker(f"file://{broker_dir}")
+    assert broker.latest_offset("GwUp") == 1 + items + users
+    broker.close()
+    return user_ids
+
+
+def _write_conf(path: str, broker_dir: str, port: int,
+                extra: dict) -> None:
+    kv = {
+        "oryx.id": "gw-bench",
+        "oryx.input-topic.broker": f"file://{broker_dir}",
+        "oryx.input-topic.message.topic": "GwIn",
+        "oryx.input-topic.partitions": 1,
+        "oryx.update-topic.broker": f"file://{broker_dir}",
+        "oryx.update-topic.message.topic": "GwUp",
+        "oryx.serving.model-manager-class":
+            "oryx_tpu.app.als.serving_manager.ALSServingModelManager",
+        "oryx.serving.application-resources": "oryx_tpu.serving.als",
+        "oryx.serving.api.port": port,
+        "oryx.resilience.supervisor.enabled": False,
+        "oryx.cluster.heartbeat-interval-ms": 250,
+        "oryx.cluster.heartbeat-ttl-ms": 1500,
+    }
+    kv.update(extra)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(keys_to_hocon(sorted(kv.items())))
+
+
+def _spawn(args: list[str], conf: str, threads: int | None,
+           log_path: str) -> subprocess.Popen:
+    env = dict(os.environ, JAX_PLATFORMS=os.environ.get(
+        "JAX_PLATFORMS", "cpu"))
+    if threads:
+        # one compute thread per replica: fixed per-replica hardware
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                            + " --xla_cpu_multi_thread_eigen=false "
+                            "intra_op_parallelism_threads="
+                            f"{threads}").strip()
+        env["OMP_NUM_THREADS"] = str(threads)
+    log = open(log_path, "ab")
+    return subprocess.Popen(
+        [sys.executable, "-m", "oryx_tpu", *args, "--conf", conf],
+        env=env, stdout=log, stderr=log)
+
+
+def _get_json(port: int, path: str, timeout: float = 10.0):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+        return json.loads(r.read() or b"null")
+
+
+def _await(predicate, what: str, timeout: float = 300.0) -> None:
+    t_end = time.monotonic() + timeout
+    while time.monotonic() < t_end:
+        try:
+            if predicate():
+                return
+        except Exception:  # noqa: BLE001 — still coming up
+            pass
+        time.sleep(0.5)
+    raise RuntimeError(f"timed out waiting for {what}")
+
+
+def run_cell(replicas: int, items: int, features: int, users: int,
+             rates: list[float], duration_sec: float,
+             replica_threads: int, work_dir: str,
+             broker_dir: str | None = None,
+             user_ids: list[str] | None = None,
+             device_ms_per_mrow: float = 0.0,
+             spot_users: int = 20) -> dict:
+    publish_s = 0.0
+    if broker_dir is None:
+        broker_dir = os.path.join(work_dir, f"broker-{replicas}")
+        os.makedirs(broker_dir, exist_ok=True)
+        t0 = time.time()
+        user_ids = _publish_model(broker_dir, users, items, features)
+        publish_s = time.time() - t0
+
+    procs: list[subprocess.Popen] = []
+    replica_ports = [_free_port() for _ in range(replicas)]
+    router_port = _free_port()
+    log_path = os.path.join(work_dir, f"cell-{replicas}.log")
+    # per-replica catalog slice: what the emulated device streams
+    slice_rows = items / replicas
+    try:
+        for s in range(replicas):
+            conf = os.path.join(work_dir, f"replica-{replicas}-{s}.conf")
+            extra = {
+                "oryx.cluster.enabled": True,
+                "oryx.cluster.shard": f"{s}/{replicas}",
+            }
+            if device_ms_per_mrow > 0:
+                # fixed-rate accelerator emulation: each scoring
+                # dispatch sleeps for the time a device streaming this
+                # replica's slice would take (time ∝ rows — the
+                # measured phase-A roofline shape), WITHOUT burning
+                # host CPU.  On a shared CPU box this is the only
+                # honest way to measure the GATEWAY's scaling: a real
+                # deployment gives each replica its own accelerator,
+                # while a co-located CPU "device" just splits the same
+                # cores.  Staged through the standard fault registry.
+                # max-batch gives the emulated device a finite
+                # per-window capacity (a real device's window ladder
+                # is bounded too); without it, unbounded coalescing
+                # amortizes ANY fixed window cost away and the
+                # measurement collapses back into host-CPU scheduling.
+                # pipeline-depth 2 pins the batcher's in-flight cap
+                # (one window executing + one queued — a double-
+                # buffered device stream): the adaptive cap learns
+                # from completion gaps that a sleep-emulated device
+                # renders meaningless, and wherever it wanders the
+                # cell's ceiling follows — two same-config runs
+                # measured 1.8x apart.  Pinned, the emulated ceiling
+                # is deterministic: pipeline x max-batch / delay.
+                delay = device_ms_per_mrow * slice_rows / 1e6
+                extra.update({
+                    "oryx.serving.api.max-batch": 8,
+                    "oryx.serving.api.scoring-pipeline-depth": 2,
+                    "oryx.resilience.faults.serving-scan-dispatch"
+                    ".mode": "delay",
+                    "oryx.resilience.faults.serving-scan-dispatch"
+                    ".times": -1,
+                    "oryx.resilience.faults.serving-scan-dispatch"
+                    ".delay-ms": round(delay, 3),
+                })
+            _write_conf(conf, broker_dir, replica_ports[s], extra)
+            procs.append(_spawn(["serving", "--shard",
+                                 f"{s}/{replicas}"], conf,
+                                replica_threads, log_path))
+        conf = os.path.join(work_dir, f"router-{replicas}.conf")
+        _write_conf(conf, broker_dir, router_port, {})
+        procs.append(_spawn(["router"], conf, None, log_path))
+
+        def _loaded(port: int) -> bool:
+            m = _get_json(port, "/shard/meta")
+            # ready fires at the 80% load gate, with the user store
+            # still filling (items stream first); the bench drives
+            # real user ids, so wait for the full replay
+            return bool(m.get("ready")) and m.get("users", 0) >= users
+
+        t0 = time.time()
+        _await(lambda: all(_loaded(p) for p in replica_ports),
+               "replica model load", timeout=900.0)
+        load_s = time.time() - t0
+        _await(lambda: _get_json(router_port, "/metrics")
+               ["cluster"]["covered_shards"] == list(range(replicas)),
+               "router coverage")
+
+        # correctness spot-check: router merge == exact merge of the
+        # replicas' own /shard/recommend answers
+        spot_ok = True
+        for uid in user_ids[:spot_users]:
+            got = [d["id"] for d in _get_json(
+                router_port, f"/recommend/{uid}?howMany=10")]
+            rows = []
+            for p in replica_ports:
+                payload = _get_json(p, f"/shard/recommend/{uid}"
+                                       "?howMany=10")
+                rows.extend(tuple(r) for r in payload["rows"])
+            rows.sort(key=lambda r: (-r[1], r[2], r[0]))
+            want = [r[0] for r in rows[:10]]
+            if got != want:
+                spot_ok = False
+                break
+
+        # warm-up burst: compiles the serving window ladder (and the
+        # router's connection pools) before any rung is judged — a
+        # multi-second XLA compile inside a rated rung reads as
+        # saturation
+        run_recommend_open_loop(
+            f"http://127.0.0.1:{router_port}", user_ids, rate_qps=30,
+            duration_sec=max(6.0, duration_sec), workers=64)
+
+        ladder, best = [], None
+        for rate in rates:
+            out = run_recommend_open_loop(
+                f"http://127.0.0.1:{router_port}", user_ids,
+                rate_qps=rate, duration_sec=duration_sec,
+                workers=min(256, max(64, int(rate))))
+            if not out["sustained"]:
+                # one retry absorbs a transient stall (a late compile,
+                # a heartbeat-file fsync burst) before the rung counts
+                out = run_recommend_open_loop(
+                    f"http://127.0.0.1:{router_port}", user_ids,
+                    rate_qps=rate, duration_sec=duration_sec,
+                    workers=min(256, max(64, int(rate))))
+            ladder.append(out)
+            if out["sustained"]:
+                best = out
+            else:
+                break
+        partials = _get_json(router_port, "/metrics")["counters"].get(
+            "partial_answers", 0)
+        return {
+            "replicas": replicas,
+            "items": items,
+            "features": features,
+            "users": users,
+            "replica_threads": replica_threads,
+            "emulated_device_ms_per_mrow": device_ms_per_mrow,
+            "emulated_dispatch_delay_ms":
+                round(device_ms_per_mrow * slice_rows / 1e6, 3),
+            "emulated_window_cap": (8 if device_ms_per_mrow > 0
+                                    else None),
+            "emulated_pipeline_depth": (2 if device_ms_per_mrow > 0
+                                        else None),
+            "publish_s": round(publish_s, 1),
+            "model_load_s": round(load_s, 1),
+            "merge_spotcheck_ok": spot_ok,
+            "partial_answers_during_run": partials,
+            "open_loop_sustained_qps":
+                best["achieved_qps"] if best else 0.0,
+            "sustained_p50_ms": best["p50_ms"] if best else None,
+            "sustained_p95_ms": best["p95_ms"] if best else None,
+            "ladder": ladder,
+        }
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--replicas", default="1,2,4",
+                    help="comma list of replica counts")
+    ap.add_argument("--items", type=int, default=524288,
+                    help="catalog size; the default keeps every cell "
+                         "(full, half, quarter catalog per replica) on "
+                         "the SAME flat scan kernel family — a cell "
+                         "ladder straddling the streaming threshold "
+                         "would compare different kernels, not "
+                         "replica counts")
+    ap.add_argument("--features", type=int, default=129,
+                    help="129 pads to the 256-lane device width: the "
+                         "per-window scan cost of a 250-feature model "
+                         "at roughly half the publish/replay bytes")
+    ap.add_argument("--users", type=int, default=1000)
+    ap.add_argument("--rates", default="",
+                    help="explicit comma rate ladder (default: "
+                         "geometric from 20)")
+    ap.add_argument("--duration", type=float, default=8.0)
+    ap.add_argument("--replica-threads", type=int, default=1,
+                    help="XLA host compute threads per replica (fixed "
+                         "per-replica hardware emulation)")
+    ap.add_argument("--device-ms-per-mrow", type=float, default=0.0,
+                    help="emulate a fixed-rate per-replica accelerator: "
+                         "every scoring dispatch sleeps this many ms "
+                         "per million catalog rows in the replica's "
+                         "slice (no host CPU burned).  0 = off (scan "
+                         "cost is the host CPU itself — only "
+                         "meaningful when cores >> replicas)")
+    ap.add_argument("--out", default="BENCH_GATEWAY_r07.json")
+    ap.add_argument("--keep-work", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.rates:
+        rates = [float(r) for r in args.rates.split(",")]
+    else:
+        rates, r = [], 20.0
+        while r <= 4000.0:
+            rates.append(round(r))
+            r *= 1.35
+
+    work_dir = tempfile.mkdtemp(prefix="oryx-gw-bench-")
+    rows = []
+    try:
+        # one shared broker/model stream: every cell's replicas replay
+        # the identical totally-ordered topic (cells run sequentially;
+        # dead cells' heartbeats age out past the TTL)
+        broker_dir = os.path.join(work_dir, "broker")
+        os.makedirs(broker_dir, exist_ok=True)
+        t0 = time.time()
+        user_ids = _publish_model(broker_dir, args.users, args.items,
+                                  args.features)
+        publish_s = round(time.time() - t0, 1)
+        print(f"== published model stream in {publish_s}s ==",
+              file=sys.stderr)
+        for n in [int(x) for x in args.replicas.split(",") if x]:
+            print(f"== cell: {n} replica(s) ==", file=sys.stderr)
+            row = run_cell(
+                n, args.items, args.features, args.users, rates,
+                args.duration, args.replica_threads, work_dir,
+                broker_dir=broker_dir, user_ids=user_ids,
+                device_ms_per_mrow=args.device_ms_per_mrow)
+            row["publish_s"] = publish_s
+            rows.append(row)
+            print(json.dumps({k: v for k, v in rows[-1].items()
+                              if k != "ladder"}), file=sys.stderr)
+    finally:
+        if not args.keep_work:
+            shutil.rmtree(work_dir, ignore_errors=True)
+
+    by_n = {r["replicas"]: r["open_loop_sustained_qps"] for r in rows}
+    report = {
+        "metric": "gateway_recommend_scaling",
+        "emulated_device_ms_per_mrow": args.device_ms_per_mrow,
+        "backend": "cpu" if os.environ.get(
+            "JAX_PLATFORMS", "cpu") == "cpu" else "tpu",
+        "host_cpus": os.cpu_count(),
+        "rows": rows,
+        "scaling_vs_1": {
+            str(n): round(q / by_n[1], 2)
+            for n, q in sorted(by_n.items()) if 1 in by_n and by_n[1]},
+    }
+    with open(args.out, "w", encoding="utf-8") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps({k: v for k, v in report.items() if k != "rows"}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

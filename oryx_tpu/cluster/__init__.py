@@ -1,0 +1,35 @@
+"""Horizontally-sharded serving cluster (scale-OUT, not just scale-up).
+
+``parallel/serving_dist.py`` shards the item scan over the devices of
+ONE host; this package shards the item *catalog* over serving
+processes, so both capacity and sustained qps scale with replica count
+(the reference runs N full-model instances behind a dumb load balancer
+— SURVEY serving-layer notes; here each replica holds 1/N of the
+catalog and the gateway merges exactly).
+
+Pieces:
+
+- :mod:`.sharding` — stable item-id -> shard hash (the Kafka
+  partitioner contract, kafka/partitioner.py).
+- :mod:`.membership` — replica heartbeats on the update topic
+  (``HB`` key, riding next to MODEL/UP) and the router's live,
+  generation-aware registry built from them.
+- :mod:`.shard_resources` — the replica-internal HTTP surface
+  (``/shard/recommend`` and friends) answering exact local top-k with
+  merge ordinals.
+- :mod:`.merge` — the exact global top-N merge with the cluster's
+  canonical tie-break.
+- :mod:`.scatter` — deadline-propagating, hedging, circuit-broken
+  fan-out client.
+- :mod:`.router` — the gateway layer: the existing public HTTP front
+  end, answered by scatter-gather over the shard replicas, degrading
+  to partial answers (``X-Oryx-Partial``) when shards are down.
+
+Run a 2-shard cluster::
+
+    python -m oryx_tpu serving --shard 0/2 --conf my.conf &
+    python -m oryx_tpu serving --shard 1/2 --conf my.conf &
+    python -m oryx_tpu router --conf my.conf &
+
+See docs/SCALING.md for the topology and protocol.
+"""

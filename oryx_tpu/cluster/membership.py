@@ -1,0 +1,267 @@
+"""Replica membership: heartbeats on the update topic + the router's
+live registry.
+
+Replicas publish small JSON heartbeats under the ``HB`` key on the
+same update topic that carries MODEL/MODEL-REF/UP — no extra
+infrastructure, and the router discovers replicas by tailing the topic
+it already understands.  Every update-topic consumer that is not the
+router must skip ``HB`` records (:func:`without_heartbeats`); they are
+control-plane traffic, not model state.
+
+A heartbeat carries the replica's shard assignment, its public URL,
+the model *generation* it is currently serving (count of accepted
+MODEL/MODEL-REF documents since replay offset 0 — identical across
+replicas because the update topic is totally ordered), and a ``ready``
+flag (fraction loaded past the serving gate).  The registry routes
+only to ready replicas and, within a shard, prefers the newest
+generation — a replica still replaying an older model is never routed.
+
+Liveness is judged by *receive* time (router monotonic clock), not the
+sender's timestamp, so clock skew between hosts cannot fake liveness.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import uuid
+from dataclasses import dataclass
+from typing import Iterable, Iterator
+
+from ..kafka.api import KeyMessage
+from ..resilience import faults
+
+_log = logging.getLogger(__name__)
+
+__all__ = ["KEY_HEARTBEAT", "Heartbeat", "MembershipRegistry",
+           "HeartbeatPublisher", "without_heartbeats"]
+
+# update-topic key for replica heartbeats (rides next to MODEL/UP;
+# consumers that build model state skip it)
+KEY_HEARTBEAT = "HB"
+
+
+def without_heartbeats(updates: Iterable[KeyMessage]) -> Iterator[KeyMessage]:
+    """Drop cluster heartbeats from an update-topic stream — the filter
+    every model-state consumer (serving/speed) tails through."""
+    return (km for km in updates if km.key != KEY_HEARTBEAT)
+
+
+@dataclass
+class Heartbeat:
+    replica: str          # stable per-process id
+    shard: int            # catalog shard this replica serves
+    of: int               # total shard count the replica was started with
+    url: str              # public base URL, e.g. http://10.0.0.3:8080
+    generation: int       # accepted MODEL documents since replay offset 0
+    ready: bool           # fraction loaded past the serving gate
+    fraction: float = 0.0
+    ts: float = 0.0       # sender wall clock (diagnostic only)
+
+    def to_json(self) -> str:
+        return json.dumps(self.__dict__, separators=(",", ":"))
+
+    @classmethod
+    def from_json(cls, s: str) -> "Heartbeat | None":
+        try:
+            d = json.loads(s)
+            return cls(replica=str(d["replica"]), shard=int(d["shard"]),
+                       of=int(d["of"]), url=str(d["url"]),
+                       generation=int(d["generation"]),
+                       ready=bool(d["ready"]),
+                       fraction=float(d.get("fraction", 0.0)),
+                       ts=float(d.get("ts", 0.0)))
+        except (ValueError, TypeError, KeyError):
+            return None  # malformed control message: ignore, don't die
+
+
+class MembershipRegistry:
+    """Router-side view of the cluster, built from heartbeats.
+
+    ``candidates(shard)`` returns the ready replicas of a shard, newest
+    generation first (ties rotated round-robin for load spreading).
+    ``shard_count`` is learned from heartbeats (the max ``of``
+    announced), so the router needs no shard-count config of its own
+    and reports partial answers as ``m/N`` against the true topology.
+    """
+
+    def __init__(self, ttl_sec: float, clock=time.monotonic):
+        self.ttl_sec = ttl_sec
+        self._clock = clock
+        self._lock = threading.Lock()
+        # replica id -> (Heartbeat, last_seen_monotonic)
+        self._replicas: dict[str, tuple[Heartbeat, float]] = {}
+        self._of = 0
+        self._rr = 0
+        self.heartbeats_seen = 0
+
+    def note(self, hb: Heartbeat) -> None:
+        with self._lock:
+            self.heartbeats_seen += 1
+            self._replicas[hb.replica] = (hb, self._clock())
+            if hb.of > self._of:
+                self._of = hb.of
+
+    def note_message(self, message: str) -> None:
+        hb = Heartbeat.from_json(message)
+        if hb is not None:
+            self.note(hb)
+        else:
+            _log.warning("Malformed heartbeat ignored")
+
+    @property
+    def shard_count(self) -> int:
+        with self._lock:
+            return self._topology_locked()
+
+    def _live_locked(self) -> list[Heartbeat]:
+        now = self._clock()
+        return [hb for hb, seen in self._replicas.values()
+                if now - seen <= self.ttl_sec]
+
+    def _topology_locked(self) -> int:
+        """The cluster's CURRENT shard count: the largest ``of`` among
+        live replicas (falling back to the largest ever seen while
+        nothing is live).  Exactness requires merging replicas of ONE
+        topology only — a 1-way replica's catalog overlaps a 2-way
+        shard's, so mixing ``of`` values in a merge would duplicate
+        items; candidates() filters accordingly, which also makes a
+        reshard (start N'-way replicas, stop the old ones) cut over
+        atomically once the new topology's heartbeats dominate."""
+        live = self._live_locked()
+        if live:
+            return max(hb.of for hb in live)
+        return max(1, self._of)
+
+    def candidates(self, shard: int) -> list[Heartbeat]:
+        """Ready live replicas for a shard IN THE CURRENT TOPOLOGY:
+        newest generation first; within a generation, rotated so
+        repeated calls spread load."""
+        with self._lock:
+            of = self._topology_locked()
+            live = [hb for hb in self._live_locked()
+                    if hb.shard == shard and hb.ready and hb.of == of]
+            if not live:
+                return []
+            top_gen = max(hb.generation for hb in live)
+            newest = [hb for hb in live if hb.generation == top_gen]
+            older = [hb for hb in live if hb.generation != top_gen]
+            self._rr += 1
+            r = self._rr % len(newest)
+            # older-generation replicas stay at the tail: a hedge may
+            # still fall back to them (stale beats dead), but a replica
+            # mid-replay of a newer model is ranked behind its peers
+            older.sort(key=lambda hb: -hb.generation)
+            return newest[r:] + newest[:r] + older
+
+    def any_candidates(self) -> list[Heartbeat]:
+        """Ready live replicas of ANY shard in the current topology
+        (for endpoints served from the replicated user store), newest
+        generation first — rotation for load spreading happens WITHIN
+        the newest generation only, the same contract as
+        ``candidates()``, so a replica still replaying an older model
+        is never ranked ahead of an up-to-date one."""
+        with self._lock:
+            of = self._topology_locked()
+            live = [hb for hb in self._live_locked()
+                    if hb.ready and hb.of == of]
+            if not live:
+                return []
+            top_gen = max(hb.generation for hb in live)
+            newest = [hb for hb in live if hb.generation == top_gen]
+            older = [hb for hb in live if hb.generation != top_gen]
+            older.sort(key=lambda hb: -hb.generation)
+            self._rr += 1
+            r = self._rr % len(newest)
+            return newest[r:] + newest[:r] + older
+
+    def covered_shards(self) -> list[int]:
+        with self._lock:
+            of = self._topology_locked()
+            return sorted({hb.shard for hb in self._live_locked()
+                           if hb.ready and hb.of == of})
+
+    def snapshot(self) -> dict:
+        """Operator view for the router's /metrics."""
+        with self._lock:
+            now = self._clock()
+            return {
+                # the CURRENT routed topology, not the largest ever
+                # seen: after a reshard down, routing follows the live
+                # `of` and the operator view must agree with it
+                "shards": self._topology_locked(),
+                "heartbeats_seen": self.heartbeats_seen,
+                "replicas": {
+                    rid: {"shard": hb.shard, "of": hb.of, "url": hb.url,
+                          "generation": hb.generation, "ready": hb.ready,
+                          "fraction": round(hb.fraction, 4),
+                          "age_sec": round(now - seen, 3),
+                          "live": now - seen <= self.ttl_sec}
+                    for rid, (hb, seen) in sorted(self._replicas.items())},
+            }
+
+
+class HeartbeatPublisher:
+    """Replica-side heartbeat loop (a daemon thread owned by the
+    serving layer).  Publish failures are logged and retried next
+    interval — a replica that cannot reach the broker ages out of the
+    router's registry, which IS the designed degrade.  The
+    ``replica-heartbeat-drop`` fault point suppresses sends for chaos
+    tests (a partitioned-but-alive replica)."""
+
+    def __init__(self, producer, shard: int, of: int, url: str,
+                 manager, min_fraction: float,
+                 interval_sec: float = 0.5,
+                 replica_id: str | None = None):
+        self._producer = producer
+        self.shard = shard
+        self.of = of
+        self.url = url
+        self._manager = manager
+        self._min_fraction = min_fraction
+        self.interval_sec = interval_sec
+        self.replica_id = replica_id or uuid.uuid4().hex[:12]
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.published = 0
+
+    def current_heartbeat(self) -> Heartbeat:
+        model = self._manager.get_model()
+        fraction = model.get_fraction_loaded() if model is not None else 0.0
+        return Heartbeat(
+            replica=self.replica_id, shard=self.shard, of=self.of,
+            url=self.url,
+            generation=int(getattr(self._manager, "generation", 0)),
+            ready=model is not None and fraction >= self._min_fraction,
+            fraction=fraction, ts=time.time())
+
+    def publish_once(self) -> bool:
+        if faults.fire("replica-heartbeat-drop") == "drop":
+            return False  # chaos: alive but silent -> ages out of routing
+        try:
+            self._producer.send(KEY_HEARTBEAT,
+                                self.current_heartbeat().to_json())
+            self.published += 1
+            return True
+        except Exception:  # noqa: BLE001 — next interval retries
+            _log.warning("heartbeat publish failed; replica will age "
+                         "out of routing until the broker returns",
+                         exc_info=True)
+            return False
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self.publish_once()
+            self._stop.wait(self.interval_sec)
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="ClusterHeartbeat")
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(5.0)

@@ -1,0 +1,765 @@
+"""The scatter-gather gateway: the public serving front end, answered
+by a horizontally-sharded replica fleet.
+
+``python -m oryx_tpu router`` speaks the SAME public HTTP surface as a
+single serving layer — endpoints, JSON/CSV negotiation, gzip, DIGEST
+auth, HTTPS, ``X-Deadline-Ms`` — but holds no model: every item-scan
+query scatters to the catalog shards discovered via update-topic
+heartbeats (cluster/membership.py) and merges their exact local top-k
+into the exact global top-N (cluster/merge.py).  The full user store
+is replicated on every replica, so user-keyed lookups (known items,
+most-active users) proxy to any live replica, and item-vector-keyed
+math (estimates, similarity-to-item) gathers vectors from their owner
+shards and computes at the gateway with the same host arithmetic the
+single-node resources use.
+
+Anonymous/context fold-in needs the full-catalog Gramian: the router
+sums the shards' partial ``Y_s^T Y_s`` (``/shard/yty``, cached per
+(shard, generation)) — row-disjoint slices sum to exactly the full
+YtY — and runs the same ``ops.als_fold_in`` solve a replica would.
+
+Degraded partial answers: when a shard is down or past deadline the
+merge proceeds over the surviving shards, the response carries
+``X-Oryx-Partial: shards=m/N``, and ``partial_answers`` counts on
+``/metrics``.  When no shard survives: 503.  The router never
+restarts over membership changes — kill/rejoin flows through the
+registry (tests/test_cluster_it.py).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import urllib.parse
+from typing import Sequence
+
+import numpy as np
+
+from ..api.serving import OryxServingException
+from ..common.config import Config
+from ..kafka import utils as kafka_utils
+from ..kafka.inproc import InProcTopicProducer, resolve_broker
+from ..lambda_rt.http import HttpApp, Request, Route, make_server
+from ..lambda_rt.metrics import MetricsRegistry
+from ..ops import als_fold_in
+from ..ops.solver import SingularMatrixSolverException, get_solver
+from ..resilience import faults
+from ..resilience.policy import (CircuitBreaker, ResilientTopicProducer,
+                                 Retry, resilience_snapshot,
+                                 run_with_resubscribe)
+from ..serving import console
+from ..serving.als import (IDCount, IDValue, how_many_offset,
+                           parse_id_value_segments)
+from ..serving.framework import send_input
+from .membership import KEY_HEARTBEAT, MembershipRegistry
+from .merge import Row, merge_top_n
+from .scatter import ScatterGather, ShardResponse, ShardUnavailable
+from .sharding import shard_of
+
+_log = logging.getLogger(__name__)
+
+__all__ = ["RouterLayer", "ROUTES"]
+
+
+# -- request-scope helpers ----------------------------------------------------
+
+def _reg(req: Request) -> MembershipRegistry:
+    return req.context["membership"]
+
+
+def _sg(req: Request) -> ScatterGather:
+    return req.context["scatter"]
+
+
+def _partial_headers(req: Request, failed: Sequence[int]) -> dict[str, str]:
+    """The degraded-answer marker; also counts the event."""
+    if not failed:
+        return {}
+    n = _reg(req).shard_count
+    req.context["metrics"].inc("partial_answers")
+    return {"X-Oryx-Partial": f"shards={n - len(failed)}/{n}"}
+
+
+def _id_values(rows: Sequence[Row]) -> list[IDValue]:
+    return [IDValue(i, float(s)) for i, s, _ in rows]
+
+
+def _collect_rows(responses: dict[int, ShardResponse],
+                  key: str = "rows"
+                  ) -> tuple[list[list[Row]], int, list[int]]:
+    """Row lists from the 2xx shard responses, the consensus non-2xx
+    status (404 passthrough when every answering shard said 404), and
+    the shards that answered non-2xx while OTHERS had rows — replay
+    skew (e.g. one replica absorbed a new user's vector before its
+    peer): their catalog slice is missing from the merge, which must
+    surface as a partial answer, never as a silently incomplete 200."""
+    rows, statuses, odd = [], [], []
+    for shard, r in responses.items():
+        if r.ok:
+            rows.append([(str(i), float(s), int(o))
+                         for i, s, o in (r.payload or {}).get(key) or []])
+        else:
+            statuses.append(r.status)
+            odd.append(shard)
+    miss = statuses[0] if statuses and not rows \
+        and all(s == statuses[0] for s in statuses) else 0
+    return rows, miss, (sorted(odd) if rows else [])
+
+
+def _raise_for(miss: int, what: str) -> None:
+    if miss:
+        raise OryxServingException(
+            miss, what if miss == 404 else f"shard error {miss}: {what}")
+
+
+def _qs(pairs: list[tuple[str, str]]) -> str:
+    return ("?" + urllib.parse.urlencode(pairs)) if pairs else ""
+
+
+def _scatter_query(req: Request, body: dict,
+                   deadline=None) -> tuple[dict[int, ShardResponse],
+                                           list[int]]:
+    payload = json.dumps(body).encode("utf-8")
+    return _sg(req).scatter("POST", "/shard/query", payload,
+                            deadline or req.deadline)
+
+
+def _gather_vectors(req: Request, item_ids: Sequence[str] = (),
+                    user_ids: Sequence[str] = ()
+                    ) -> tuple[dict[str, np.ndarray | None],
+                               dict[str, np.ndarray | None], list[int]]:
+    """Fetch vectors: items from their owner shards, users from any
+    replica.  Returns (item id -> vector|None, user id -> vector|None,
+    failed owner shards).  Item and user vectors live in SEPARATE maps:
+    X and Y are independent stores single-node, so one string may
+    legitimately name both a user and an item."""
+    sg, n = _sg(req), _reg(req).shard_count
+    items_out: dict[str, np.ndarray | None] = {}
+    users_out: dict[str, np.ndarray | None] = {}
+    failed: list[int] = []
+    by_owner: dict[int, list[str]] = {}
+    for iid in item_ids:
+        by_owner.setdefault(shard_of(iid, n), []).append(iid)
+    for shard, ids in by_owner.items():
+        body = json.dumps({"items": ids}).encode("utf-8")
+        try:
+            r = sg.query_shard(shard, "POST", "/shard/vectors", body,
+                               req.deadline)
+        except ShardUnavailable:
+            failed.append(shard)
+            for iid in ids:
+                items_out.setdefault(iid, None)
+            continue
+        items = (r.payload or {}).get("items") or {}
+        for iid in ids:
+            v = items.get(iid)
+            items_out[iid] = None if v is None else np.asarray(v, np.float32)
+    if user_ids:
+        body = json.dumps({"users": list(user_ids)}).encode("utf-8")
+        r = sg.any_replica("POST", "/shard/vectors", body, req.deadline)
+        users = (r.payload or {}).get("users") or {}
+        for uid in user_ids:
+            v = users.get(uid)
+            users_out[uid] = None if v is None else np.asarray(v, np.float32)
+    return items_out, users_out, failed
+
+
+# -- cluster-wide Gramian (fold-in support) ----------------------------------
+
+def _cluster_solver(req: Request) -> tuple[object, bool, int, list[int]]:
+    """(solver over the summed cluster YtY, implicit flag, features,
+    failed shards).  Partial Gramians are cached per
+    (shard, generation) so a stable cluster pays one /shard/yty round
+    per shard per model generation: the registry's heartbeats already
+    carry each shard's live generation, so a cache hit for it costs no
+    network at all — /shard/yty is only fetched for shards whose
+    generation moved (or was never seen).  At f features the payload is
+    f^2 floats (~0.5 MB of JSON at f=250); shipping that per fold-in
+    request would dwarf the fold-in itself."""
+    cache: dict = req.context["yty_cache"]
+    lock = req.context["yty_lock"]
+    reg, sg = _reg(req), _sg(req)
+    n = reg.shard_count
+    entries: dict[int, tuple] = {}
+    missing: list[int] = []
+    with lock:
+        for shard in range(n):
+            cands = reg.candidates(shard)
+            entry = None
+            if cands:
+                # heartbeat generation of the replica a query would hit
+                entry = cache.get((shard, cands[0].generation))
+            if entry is None:
+                missing.append(shard)
+            else:
+                entries[shard] = entry
+    failed: list[int] = []
+    if missing:
+        # the lock covers only the cache dict — fetches run outside it
+        # (and concurrently), so one stalled shard cannot serialize
+        # every fold-in request in the cluster behind its timeout
+        try:
+            responses, failed = sg.scatter("GET", "/shard/yty",
+                                           deadline=req.deadline,
+                                           shards=missing)
+        except ShardUnavailable:
+            responses, failed = {}, list(missing)
+        with lock:
+            for shard, r in sorted(responses.items()):
+                if not r.ok or not r.payload:
+                    failed.append(shard)
+                    continue
+                entry = (np.asarray(r.payload["yty"], dtype=np.float64),
+                         bool(r.payload.get("implicit", True)),
+                         int(r.payload.get("features", 0)))
+                # one entry per shard: drop older generations.  Keyed
+                # by the generation the REPLICA reports (authoritative;
+                # a heartbeat mid-swap may lag it by one — the next
+                # request re-checks against the fresher heartbeat)
+                for k in [k for k in cache if k[0] == shard]:
+                    del cache[k]
+                cache[(shard, int(r.payload.get("generation", 0)))] = entry
+                entries[shard] = entry
+    total = None
+    implicit, features = True, 0
+    for shard in sorted(entries):
+        mat, implicit, features = entries[shard]
+        features = features or int(mat.shape[0])
+        total = mat if total is None else total + mat
+    if total is None:
+        raise OryxServingException(503, "no shard Gramian available")
+    try:
+        solver = get_solver(total)
+    except SingularMatrixSolverException as e:
+        raise OryxServingException(
+            503, "No solver available for model yet") from e
+    return solver, implicit, features, sorted(set(failed))
+
+
+def _fold_user_vector(req: Request, item_values: list[tuple[str, float]],
+                      xu: np.ndarray | None
+                      ) -> tuple[np.ndarray | None, int, list[int]]:
+    """The gateway's EstimateForAnonymous.buildTemporaryUserVector:
+    gather the context items' vectors from their owner shards, solve
+    against the summed cluster Gramian, fold sequentially (the same
+    ops.als_fold_in kernel a replica runs)."""
+    solver, implicit, features, failed = _cluster_solver(req)
+    vecs, _, failed_v = _gather_vectors(
+        req, item_ids=[i for i, _ in item_values])
+    xu = als_fold_in.fold_in_sequential(
+        solver, list(item_values), lambda i: vecs.get(i), xu,
+        implicit, features)
+    return xu, features, sorted(set(failed) | set(failed_v))
+
+
+# -- top-N family -------------------------------------------------------------
+
+def _merged_response(req: Request, rows: list[list[Row]],
+                     failed: Sequence[int], how_many: int, offset: int,
+                     lowest: bool = False):
+    merged = merge_top_n(rows, how_many, offset, lowest=lowest)
+    return 200, _id_values(merged), _partial_headers(req, failed)
+
+
+def _recommend(req: Request):
+    how_many, offset = how_many_offset(req)
+    k = how_many + offset
+    pairs = [("howMany", str(k))]
+    if req.q1("considerKnownItems"):
+        pairs.append(("considerKnownItems", req.q1("considerKnownItems")))
+    for p in req.q_list("rescorerParams"):
+        pairs.append(("rescorerParams", p))
+    path = ("/shard/recommend/"
+            + urllib.parse.quote(req.params["userID"], safe="") + _qs(pairs))
+    responses, failed = _sg(req).scatter("GET", path,
+                                         deadline=req.deadline)
+    rows, miss, odd = _collect_rows(responses)
+    _raise_for(miss, req.params["userID"])
+    return _merged_response(req, rows, sorted({*failed, *odd}),
+                            how_many, offset)
+
+
+def _recommend_to_many(req: Request):
+    how_many, offset = how_many_offset(req)
+    responses, failed = _scatter_query(req, {
+        "kind": "recommendToMany",
+        "userIDs": req.params["userIDs"].split("/"),
+        "considerKnownItems":
+            req.q1("considerKnownItems", "false") == "true",
+        "howMany": how_many + offset,
+        "rescorerParams": req.q_list("rescorerParams")})
+    rows, miss, odd = _collect_rows(responses)
+    _raise_for(miss, req.params["userIDs"])
+    return _merged_response(req, rows, sorted({*failed, *odd}),
+                            how_many, offset)
+
+
+def _by_vector_scatter(req: Request, vectors, how_many: int,
+                       exclude=(), cosine=False, lowest=False,
+                       exclude_known_of=None, rescorer_hook=None,
+                       rescorer_args=()):
+    body = {"kind": "byVector",
+            "vectors": [[float(x) for x in np.asarray(v, np.float32)]
+                        for v in vectors],
+            "howMany": how_many, "exclude": sorted(exclude),
+            "cosine": cosine, "lowest": lowest}
+    if exclude_known_of:
+        body["excludeKnownOf"] = exclude_known_of
+    if rescorer_hook:
+        body["rescorerHook"] = rescorer_hook
+        body["rescorerArgs"] = list(rescorer_args)
+        body["rescorerParams"] = req.q_list("rescorerParams")
+    return _scatter_query(req, body)
+
+
+def _multi_rows(responses: dict[int, ShardResponse],
+                index: int) -> list[list[Row]]:
+    out = []
+    for r in responses.values():
+        if r.ok:
+            multi = (r.payload or {}).get("multi") or []
+            if index < len(multi):
+                out.append([(str(i), float(s), int(o))
+                            for i, s, o in multi[index]])
+    return out
+
+
+def _recommend_to_anonymous(req: Request):
+    item_values = parse_id_value_segments(req.params["itemIDs"])
+    how_many, offset = how_many_offset(req)
+    xu, _, failed_fold = _fold_user_vector(req, item_values, None)
+    if xu is None:
+        raise OryxServingException(404, req.params["itemIDs"])
+    known = sorted({i for i, _ in item_values})
+    responses, failed = _by_vector_scatter(
+        req, [xu], how_many + offset, exclude=known,
+        rescorer_hook="get_recommend_to_anonymous_rescorer",
+        rescorer_args=[known])
+    rows = _multi_rows(responses, 0)
+    return _merged_response(req, rows, sorted(set(failed) | set(failed_fold)),
+                            how_many, offset)
+
+
+def _recommend_with_context(req: Request):
+    user_id = req.params["userID"]
+    item_values = parse_id_value_segments(req.params["itemIDs"])
+    how_many, offset = how_many_offset(req)
+    _, users, _ = _gather_vectors(req, user_ids=[user_id])
+    xu = users.get(user_id)
+    if xu is None:
+        raise OryxServingException(404, user_id)
+    xu, _, failed_fold = _fold_user_vector(req, item_values, xu)
+    responses, failed = _by_vector_scatter(
+        req, [xu], how_many + offset,
+        exclude={i for i, _ in item_values}, exclude_known_of=user_id,
+        rescorer_hook="get_recommend_rescorer", rescorer_args=[user_id])
+    rows = _multi_rows(responses, 0)
+    return _merged_response(req, rows, sorted(set(failed) | set(failed_fold)),
+                            how_many, offset)
+
+
+# -- similarity family --------------------------------------------------------
+
+def _similarity(req: Request):
+    item_ids = req.params["itemIDs"].split("/")
+    how_many, offset = how_many_offset(req)
+    vecs, _, failed_own = _gather_vectors(req, item_ids=item_ids)
+    for iid in item_ids:
+        if vecs.get(iid) is None:
+            if shard_of(iid, _reg(req).shard_count) in failed_own:
+                raise OryxServingException(
+                    503, f"shard owning {iid} unavailable")
+            raise OryxServingException(404, iid)
+    responses, failed = _by_vector_scatter(
+        req, [vecs[i] for i in item_ids], how_many + offset,
+        exclude=set(item_ids), cosine=True,
+        rescorer_hook="get_most_similar_items_rescorer")
+    rows = _multi_rows(responses, 0)
+    return _merged_response(req, rows, failed, how_many, offset)
+
+
+def _similarity_to_item(req: Request):
+    to_item = req.params["toItemID"]
+    item_ids = req.params["itemIDs"].split("/")
+    vecs, _, failed_own = _gather_vectors(req, item_ids=[to_item] + item_ids)
+
+    def _vec(iid):
+        v = vecs.get(iid)
+        if v is None:
+            if shard_of(iid, _reg(req).shard_count) in failed_own:
+                raise OryxServingException(
+                    503, f"shard owning {iid} unavailable")
+            raise OryxServingException(404, iid)
+        return v
+
+    to_vec = _vec(to_item)
+    to_norm = float(np.linalg.norm(to_vec))
+    out = []
+    for iid in item_ids:
+        v = _vec(iid)
+        denom = to_norm * float(np.linalg.norm(v))
+        out.append(IDValue(iid, float(np.dot(v, to_vec)) / denom
+                           if denom > 0 else 0.0))
+    return out
+
+
+# -- estimates ----------------------------------------------------------------
+
+def _estimate(req: Request):
+    user_id = req.params["userID"]
+    item_ids = req.params["itemIDs"].split("/")
+    vecs, users, failed = _gather_vectors(req, item_ids=item_ids,
+                                          user_ids=[user_id])
+    xu = users.get(user_id)
+    if xu is None:
+        raise OryxServingException(404, user_id)
+    out = []
+    for iid in item_ids:
+        yi = vecs.get(iid)
+        out.append(IDValue(iid, 0.0 if yi is None
+                           else float(xu @ yi)))
+    # items owned by a dead shard estimate as 0.0 (the unknown-item
+    # value) under the partial marker rather than failing the request
+    return 200, out, _partial_headers(req, failed)
+
+
+def _estimate_for_anonymous(req: Request):
+    to_item = req.params["toItemID"]
+    vecs, _, failed_own = _gather_vectors(req, item_ids=[to_item])
+    to_vec = vecs.get(to_item)
+    if to_vec is None:
+        if shard_of(to_item, _reg(req).shard_count) in failed_own:
+            raise OryxServingException(
+                503, f"shard owning {to_item} unavailable")
+        raise OryxServingException(404, to_item)
+    item_values = parse_id_value_segments(req.params["itemIDs"])
+    xu, _, failed = _fold_user_vector(req, item_values, None)
+    value = 0.0 if xu is None else float(np.dot(xu, to_vec))
+    return 200, value, _partial_headers(req, failed)
+
+
+# -- known-items math ---------------------------------------------------------
+
+def _because(req: Request):
+    how_many, offset = how_many_offset(req)
+    item_id = req.params["itemID"]
+    vecs, _, failed_own = _gather_vectors(req, item_ids=[item_id])
+    target = vecs.get(item_id)
+    if target is None:
+        if shard_of(item_id, _reg(req).shard_count) in failed_own:
+            raise OryxServingException(
+                503, f"shard owning {item_id} unavailable")
+        raise OryxServingException(404, item_id)
+    responses, failed = _scatter_query(req, {
+        "kind": "because", "userID": req.params["userID"],
+        "vector": [float(x) for x in target],
+        "howMany": how_many + offset})
+    rows, miss, odd = _collect_rows(responses)
+    _raise_for(miss, req.params["userID"])
+    return _merged_response(req, rows, sorted({*failed, *odd}),
+                            how_many, offset)
+
+
+def _most_surprising(req: Request):
+    how_many, offset = how_many_offset(req)
+    responses, failed = _scatter_query(req, {
+        "kind": "mostSurprising", "userID": req.params["userID"],
+        "howMany": how_many + offset})
+    rows, miss, odd = _collect_rows(responses)
+    _raise_for(miss, req.params["userID"])
+    return _merged_response(req, rows, sorted({*failed, *odd}),
+                            how_many, offset, lowest=True)
+
+
+# -- proxied user-store endpoints --------------------------------------------
+
+def _proxy_any(req: Request):
+    """Forward to any live replica: these endpoints answer from the
+    user store / known-items map, which every replica holds in full."""
+    query = ""
+    if req.query:
+        query = "?" + urllib.parse.urlencode(
+            [(k, v) for k, vs in req.query.items() for v in vs])
+    # req.path arrives URL-DECODED from the front end: re-quote it for
+    # the hand-rolled request line (an id with a space or non-latin-1
+    # characters must round-trip the internal hop like any other)
+    path = urllib.parse.quote(req.path, safe="/")
+    try:
+        r = _sg(req).any_replica("GET", path + query,
+                                 deadline=req.deadline)
+    except ShardUnavailable as e:
+        raise OryxServingException(503, str(e)) from e
+    if not r.ok:
+        raise OryxServingException(r.status, str(r.payload))
+    return r.payload
+
+
+def _most_counts(req: Request):
+    payload = _proxy_any(req)
+    return [IDCount(str(d["id"]), int(d["count"])) for d in payload or []]
+
+
+def _all_item_ids(req: Request):
+    responses, failed = _scatter_query(req, {"kind": "allItemIDs"})
+    seen, out = set(), []
+    for _, r in sorted(responses.items()):
+        if r.ok:
+            for i in (r.payload or {}).get("ids") or []:
+                if i not in seen:
+                    seen.add(i)
+                    out.append(i)
+    return 200, out, _partial_headers(req, failed)
+
+
+def _popular_representative_items(req: Request):
+    try:
+        meta = _sg(req).any_replica("GET", "/shard/meta",
+                                    deadline=req.deadline)
+    except ShardUnavailable as e:
+        raise OryxServingException(503, str(e)) from e
+    features = int((meta.payload or {}).get("features") or 0)
+    if not features:
+        raise OryxServingException(503, "Model not available yet")
+    eye = np.eye(features, dtype=np.float32)
+    responses, failed = _by_vector_scatter(req, list(eye), 1)
+    items = []
+    for i in range(features):
+        top = merge_top_n(_multi_rows(responses, i), 1)
+        items.append(top[0][0] if top else None)
+    return 200, items, _partial_headers(req, failed)
+
+
+# -- write path ---------------------------------------------------------------
+
+def _gate_writes(req: Request) -> None:
+    # parity with the single-node model gate: 503 while nothing could
+    # serve the data back (no live replica at all)
+    if not _reg(req).any_candidates():
+        raise OryxServingException(503, "no live replica")
+
+
+def _pref_post(req: Request):
+    _gate_writes(req)
+    body = req.body.decode().strip()
+    value = body if body else "1"
+    float(value)
+    send_input(req, f"{req.params['userID']},{req.params['itemID']},{value}")
+    return None
+
+
+def _pref_delete(req: Request):
+    _gate_writes(req)
+    send_input(req, f"{req.params['userID']},{req.params['itemID']},")
+    return None
+
+
+def _ingest(req: Request):
+    from ..serving.als import _ingest as serving_ingest
+    _gate_writes(req)
+    return serving_ingest(req)
+
+
+# -- framework ----------------------------------------------------------------
+
+def _ready(req: Request):
+    """200 when every catalog shard has a live ready replica."""
+    reg = _reg(req)
+    covered = reg.covered_shards()
+    if len(covered) < reg.shard_count or reg.shard_count < 1:
+        raise OryxServingException(
+            503, f"shards covered: {len(covered)}/{reg.shard_count}")
+    return None
+
+
+def _metrics(req: Request):
+    registry: MetricsRegistry = req.context["metrics"]
+    return {
+        "routes": registry.snapshot(),
+        "counters": registry.counters_snapshot(),
+        "cluster": {
+            "membership": _reg(req).snapshot(),
+            "scatter": _sg(req).stats(),
+            "covered_shards": _reg(req).covered_shards(),
+        },
+        "resilience": resilience_snapshot(),
+    }
+
+
+def _error(req: Request):
+    from ..serving.framework import _error as framework_error
+    return framework_error(req)
+
+
+ROUTES = [
+    Route("GET", "/recommend/{userID}", _recommend),
+    Route("GET", "/recommendToMany/{userIDs:+}", _recommend_to_many),
+    Route("GET", "/recommendToAnonymous/{itemIDs:+}",
+          _recommend_to_anonymous),
+    Route("GET", "/recommendWithContext/{userID}/{itemIDs:+}",
+          _recommend_with_context),
+    Route("GET", "/similarity/{itemIDs:+}", _similarity),
+    Route("GET", "/similarityToItem/{toItemID}/{itemIDs:+}",
+          _similarity_to_item),
+    Route("GET", "/estimate/{userID}/{itemIDs:+}", _estimate),
+    Route("GET", "/estimateForAnonymous/{toItemID}/{itemIDs:+}",
+          _estimate_for_anonymous),
+    Route("GET", "/because/{userID}/{itemID}", _because),
+    Route("GET", "/mostSurprising/{userID}", _most_surprising),
+    Route("GET", "/mostActiveUsers", _most_counts),
+    Route("GET", "/mostPopularItems", _most_counts),
+    Route("GET", "/popularRepresentativeItems",
+          _popular_representative_items),
+    Route("GET", "/user/allIDs", _proxy_any),
+    Route("GET", "/allUserIDs", _proxy_any),
+    Route("GET", "/item/allIDs", _all_item_ids),
+    Route("GET", "/allItemIDs", _all_item_ids),
+    Route("GET", "/knownItems/{userID}", _proxy_any),
+    Route("POST", "/pref/{userID}/{itemID}", _pref_post, mutates=True),
+    Route("DELETE", "/pref/{userID}/{itemID}", _pref_delete, mutates=True),
+    Route("POST", "/ingest", _ingest, mutates=True),
+    Route("GET", "/ready", _ready),
+    Route("GET", "/metrics", _metrics),
+    Route("GET", "/error", _error),
+    console.console_route("ALS scatter-gather gateway", [
+        console.Endpoint("/recommend/{0}", ("userID",)),
+        console.Endpoint("/similarity/{0}/{1}", ("itemID1", "itemID2")),
+        console.Endpoint("/estimate/{0}/{1}", ("userID", "itemID")),
+        console.Endpoint("/mostPopularItems"),
+        console.Endpoint("/allUserIDs"),
+        console.Endpoint("/metrics"),
+        console.Endpoint("/ready"),
+    ]),
+]
+
+
+class RouterLayer:
+    """start()/await_()/close() around the gateway HTTP server and the
+    membership consumer — the same lifecycle contract as the other
+    layers, so ``python -m oryx_tpu router`` runs supervised like the
+    rest."""
+
+    def __init__(self, config: Config, port: int | None = None):
+        self.config = config
+        api = "oryx.serving.api"
+        self.keystore_file = config.get_optional_string(f"{api}.keystore-file")
+        self.keystore_password = config.get_optional_string(
+            f"{api}.keystore-password")
+        if port is not None:
+            self.port = port
+        elif self.keystore_file:
+            self.port = config.get_int(f"{api}.secure-port")
+        else:
+            self.port = config.get_int(f"{api}.port")
+        self.read_only = config.get_bool(f"{api}.read-only")
+        self.update_broker = config.get_optional_string(
+            "oryx.update-topic.broker")
+        self.update_topic = config.get_optional_string(
+            "oryx.update-topic.message.topic")
+        self.input_broker = config.get_optional_string(
+            "oryx.input-topic.broker")
+        self.input_topic = config.get_optional_string(
+            "oryx.input-topic.message.topic")
+        if not (self.update_broker and self.update_topic):
+            raise ValueError("router requires an update topic for "
+                             "replica membership")
+        faults.configure_from_config(config)
+        ttl = config.get_int("oryx.cluster.heartbeat-ttl-ms") / 1000.0
+        self.membership = MembershipRegistry(ttl)
+        self.scatter = ScatterGather(self.membership, config)
+        self.metrics = MetricsRegistry()
+        self.input_producer = None
+        self.input_breaker = CircuitBreaker.from_config(
+            "router-input", config)
+        if not self.read_only and self.input_broker and self.input_topic:
+            if not config.get_bool("oryx.serving.no-init-topics"):
+                kafka_utils.maybe_create_topic(
+                    self.input_broker, self.input_topic,
+                    partitions=kafka_utils.input_topic_partitions(config))
+            self.input_producer = ResilientTopicProducer(
+                InProcTopicProducer(self.input_broker, self.input_topic),
+                retry=Retry.from_config("router-input-send", config),
+                breaker=self.input_breaker)
+        self._stop = threading.Event()
+        self._consume_thread: threading.Thread | None = None
+        self._server = None
+        self._server_thread: threading.Thread | None = None
+        self.app = HttpApp(
+            ROUTES,
+            context={
+                "membership": self.membership,
+                "scatter": self.scatter,
+                "metrics": self.metrics,
+                "config": config,
+                "input_producer": self.input_producer,
+                "yty_cache": {},
+                "yty_lock": threading.Lock(),
+            },
+            read_only=self.read_only,
+            user_name=config.get_optional_string(f"{api}.user-name"),
+            password=config.get_optional_string(f"{api}.password"),
+            context_path=config.get_string(f"{api}.context-path"),
+            request_deadline_ms=config.get_int(
+                "oryx.resilience.request-deadline-ms"),
+        )
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def _consume_membership(self) -> None:
+        broker = resolve_broker(self.update_broker)
+
+        def tail():
+            # from the CURRENT end: membership is periodic state, not
+            # history — replicas re-announce every interval, so the
+            # registry is complete one heartbeat period after start
+            for km in broker.consume(self.update_topic,
+                                     from_beginning=False,
+                                     stop=self._stop):
+                if km.key == KEY_HEARTBEAT:
+                    self.membership.note_message(km.message)
+
+        run_with_resubscribe(tail, stop=self._stop,
+                             what="router membership consumer", log=_log)
+
+    def start(self) -> None:
+        self._consume_thread = threading.Thread(
+            target=self._consume_membership, daemon=True,
+            name="RouterMembership")
+        self._consume_thread.start()
+        ssl_context = None
+        if self.keystore_file:
+            import ssl
+            ssl_context = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ssl_context.load_cert_chain(self.keystore_file,
+                                        password=self.keystore_password)
+        self._server = make_server(self.app, self.port,
+                                   ssl_context=ssl_context)
+        self.port = self._server.server_address[1]
+        self.scheme = "https" if ssl_context is not None else "http"
+        self._server_thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="RouterHTTP")
+        self._server_thread.start()
+        _log.info("Router listening on port %d", self.port)
+
+    def await_(self) -> None:
+        while self._server_thread and self._server_thread.is_alive():
+            self._server_thread.join(1.0)
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._server:
+            self._server.shutdown()
+        self.scatter.close()
+        if self.input_producer:
+            self.input_producer.close()
+        for t in (self._consume_thread, self._server_thread):
+            if t:
+                t.join(10.0)
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

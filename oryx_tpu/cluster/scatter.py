@@ -1,0 +1,511 @@
+"""Deadline-propagating, hedging, circuit-broken fan-out to shard
+replicas.
+
+One :class:`ScatterGather` lives on the router.  Per public request it
+queries every catalog shard (``scatter``) or any one replica
+(``any_replica`` — for endpoints answered from the replicated user
+store).  Per shard it walks the membership registry's candidates
+(ready, newest generation first) with *hedged* attempts: the first
+replica gets ``hedge-after-ms`` to answer before a second attempt is
+launched against the next replica — both stay in flight and the first
+success wins, so one slow replica costs the hedge window, not the
+whole deadline.  Every attempt runs behind a per-replica
+:class:`~oryx_tpu.resilience.policy.CircuitBreaker` (a dead replica is
+shed in microseconds until its half-open probe passes) and carries the
+request's REMAINING deadline downstream as ``X-Deadline-Ms`` so a
+shard never computes an answer nobody is waiting for.
+
+Transport is a hand-rolled keep-alive HTTP/1.1 client over a per-URL
+connection pool (the stdlib client's email-parser machinery costs real
+qps at gateway rates — same reasoning as bench/load.py's driver).  It
+speaks the replicas' whole front-door surface: TLS to ``https``
+heartbeat URLs (unverified — the cluster-internal trust model for the
+replicas' self-signed serving certs) and the serving tier's DIGEST
+auth (``qop="auth"``; credentials from ``oryx.serving.api.user-name/
+password``, so one shared ``--conf`` secures the public door and the
+scatter plane alike), with one challenge round per replica URL and
+cached-nonce reuse until the replica rotates its nonce set.
+
+HTTP responses — ANY status — are authoritative: a 404 means "user
+unknown", not "replica down", and must neither trip the breaker nor
+trigger a hedge.  Only transport errors, timeouts, and 5xx count as
+attempt failures.
+
+Chaos seam: ``router-shard-timeout`` fires once per shard query
+(mode=delay simulates a stalled shard eating the deadline; mode=error
+a shard that fails outright — the partial-answer path's test handle).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import re
+import secrets
+import socket
+import threading
+import time
+import urllib.parse
+from concurrent.futures import ThreadPoolExecutor
+from queue import Empty, SimpleQueue
+from typing import Sequence
+
+from ..api.serving import OryxServingException
+from ..resilience import faults
+from ..resilience.policy import CircuitBreaker, CircuitOpenError, Deadline
+from .membership import Heartbeat, MembershipRegistry
+
+_log = logging.getLogger(__name__)
+
+__all__ = ["ScatterGather", "ShardUnavailable", "ShardResponse"]
+
+
+class ShardUnavailable(OryxServingException):
+    """No replica of a shard produced an authoritative response within
+    the deadline — the shard drops out of the merge (partial answer).
+    An OryxServingException(503), so one escaping a router handler
+    (every shard down, no replica for a vector gather) renders as the
+    serving tier's standard 503 degrade, never a 500."""
+
+    def __init__(self, message: str):
+        super().__init__(503, message)
+
+
+class ShardResponse:
+    __slots__ = ("shard", "status", "payload", "replica")
+
+    def __init__(self, shard: int, status: int, payload, replica: str):
+        self.shard = shard
+        self.status = status
+        self.payload = payload
+        self.replica = replica
+
+    @property
+    def ok(self) -> bool:
+        return 200 <= self.status < 300
+
+
+class _Pool:
+    """Keep-alive socket pool per base URL.  ``https`` replica URLs get
+    TLS without certificate verification: the scatter plane rides the
+    cluster-internal network against the replicas' own (typically
+    self-signed) serving certs, the same trust model the repo's TLS
+    tests use client-side."""
+
+    def __init__(self, connect_timeout: float = 5.0):
+        self._conns: dict[str, list[tuple[socket.socket, object]]] = {}
+        self._lock = threading.Lock()
+        self.connect_timeout = connect_timeout
+        self._tls = None
+
+    def acquire(self, url: str) -> tuple[tuple[socket.socket, object], bool]:
+        """(connection, reused) — ``reused`` means keep-alive from the
+        pool, which may have died since its last request."""
+        with self._lock:
+            stack = self._conns.get(url)
+            if stack:
+                return stack.pop(), True
+        return self.fresh(url), False
+
+    def fresh(self, url: str) -> tuple[socket.socket, object]:
+        p = urllib.parse.urlparse(url)
+        conn = socket.create_connection((p.hostname, p.port),
+                                        timeout=self.connect_timeout)
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if p.scheme == "https":
+            if self._tls is None:
+                import ssl
+                ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+                ctx.check_hostname = False
+                ctx.verify_mode = ssl.CERT_NONE
+                self._tls = ctx
+            conn = self._tls.wrap_socket(conn, server_hostname=p.hostname)
+        return conn, conn.makefile("rb")
+
+    def release(self, url: str, conn_rf) -> None:
+        with self._lock:
+            self._conns.setdefault(url, []).append(conn_rf)
+
+    def discard(self, conn_rf) -> None:
+        try:
+            conn_rf[0].close()
+        except OSError:
+            pass
+
+    def purge(self, url: str) -> None:
+        """Drop every pooled connection for a URL — when one reused
+        socket turns out dead (replica restart), its poolmates almost
+        certainly are too."""
+        with self._lock:
+            stack = self._conns.pop(url, [])
+        for conn_rf in stack:
+            self.discard(conn_rf)
+
+    def close(self) -> None:
+        with self._lock:
+            for stack in self._conns.values():
+                for conn_rf in stack:
+                    self.discard(conn_rf)
+            self._conns.clear()
+
+
+def _request(conn, rfile, method: str, path: str, body: bytes | None,
+             headers: dict[str, str], timeout: float
+             ) -> tuple[int, bytes, dict[str, str]]:
+    conn.settimeout(max(0.001, timeout))
+    head = [f"{method} {path} HTTP/1.1", "Host: oryx-cluster",
+            "Accept: application/json"]
+    head += [f"{k}: {v}" for k, v in headers.items()]
+    if body is not None:
+        head.append(f"Content-Length: {len(body)}")
+        head.append("Content-Type: application/json")
+    payload = ("\r\n".join(head) + "\r\n\r\n").encode("latin-1")
+    if body is not None:
+        payload += body
+    conn.sendall(payload)
+    status_line = rfile.readline(65537)
+    if not status_line:
+        raise ConnectionError("replica closed connection")
+    status = int(status_line.split(b" ", 2)[1])
+    clen = 0
+    rhdrs: dict[str, str] = {}
+    while True:
+        h = rfile.readline(65537)
+        if h in (b"\r\n", b"\n", b""):
+            break
+        name, _, value = h.partition(b":")
+        rhdrs[name.strip().lower().decode("latin-1")] = \
+            value.strip().decode("latin-1")
+        if name.strip().lower() == b"content-length":
+            clen = int(value)
+    out = b""
+    while len(out) < clen:
+        got = rfile.read(clen - len(out))
+        if not got:
+            raise ConnectionError("short body from replica")
+        out += got
+    return status, out, rhdrs
+
+
+class _DigestAuth:
+    """DIGEST client for the replicas' challenge (the serving tier's
+    MD5 ``qop="auth"`` scheme — lambda_rt/http.py `_auth_ok`).  One
+    challenge round per replica URL, then the cached nonce is reused
+    with an incrementing nc; when the replica rotates its nonce set
+    (401 on a previously good nonce) the caller re-challenges."""
+
+    def __init__(self, user: str, password: str):
+        self.user = user
+        self.password = password or ""
+        # url -> (realm, nonce, next nc)
+        self._state: dict[str, tuple[str, str, int]] = {}
+        self._lock = threading.Lock()
+
+    def challenge(self, url: str, www_authenticate: str) -> bool:
+        pairs = re.findall(r'(\w+)=(?:"([^"]*)"|([^, ]*))',
+                           www_authenticate)
+        parts = {k: (q or b) for k, q, b in pairs}
+        if "nonce" not in parts:
+            return False
+        with self._lock:
+            self._state[url] = (parts.get("realm", ""), parts["nonce"], 1)
+        return True
+
+    def header(self, url: str, method: str, uri: str) -> str | None:
+        with self._lock:
+            st = self._state.get(url)
+            if st is None:
+                return None
+            realm, nonce, nc = st
+            self._state[url] = (realm, nonce, nc + 1)
+        cnonce = secrets.token_hex(8)
+        ncs = f"{nc:08x}"
+
+        def md5(s: str) -> str:
+            return hashlib.md5(s.encode()).hexdigest()
+
+        ha1 = md5(f"{self.user}:{realm}:{self.password}")
+        ha2 = md5(f"{method}:{uri}")
+        response = md5(f"{ha1}:{nonce}:{ncs}:{cnonce}:auth:{ha2}")
+        return (f'Digest username="{self.user}", realm="{realm}", '
+                f'nonce="{nonce}", uri="{uri}", qop=auth, nc={ncs}, '
+                f'cnonce="{cnonce}", response="{response}"')
+
+
+class ScatterGather:
+    def __init__(self, registry: MembershipRegistry, config,
+                 max_concurrency: int = 64):
+        self.registry = registry
+        c = "oryx.cluster"
+        self.hedge_after_sec = config.get_int(f"{c}.hedge-after-ms") / 1000.0
+        self.shard_timeout_sec = \
+            config.get_int(f"{c}.shard-timeout-ms") / 1000.0
+        self.max_attempts = config.get_int(f"{c}.max-attempts-per-shard")
+        self._config = config
+        self._pool = _Pool()
+        user = config.get_optional_string("oryx.serving.api.user-name")
+        self._auth = _DigestAuth(
+            user, config.get_optional_string("oryx.serving.api.password")
+        ) if user else None
+        self._exec = ThreadPoolExecutor(max_workers=max_concurrency,
+                                        thread_name_prefix="router-scatter")
+        self._breakers: dict[str, CircuitBreaker] = {}
+        self._lock = threading.Lock()
+        # operator counters (router /metrics)
+        self.hedges = 0
+        self.shard_failures = 0
+        self.partial_answers = 0
+
+    def close(self) -> None:
+        self._exec.shutdown(wait=False)
+        self._pool.close()
+
+    def _breaker(self, url: str) -> CircuitBreaker:
+        with self._lock:
+            b = self._breakers.get(url)
+            if b is None:
+                b = CircuitBreaker.from_config(
+                    f"router-replica[{url}]", self._config)
+                self._breakers[url] = b
+            return b
+
+    # -- one attempt ---------------------------------------------------------
+
+    def _attempt(self, hb: Heartbeat, shard: int, method: str, path: str,
+                 body: bytes | None, deadline: Deadline | None):
+        timeout = self.shard_timeout_sec
+        headers = {}
+        if deadline is not None:
+            remaining = deadline.remaining()
+            if remaining <= 0.0:
+                raise ShardUnavailable("deadline exhausted")
+            timeout = min(timeout, remaining)
+            # remaining-budget propagation: the shard sheds work the
+            # router would no longer wait for
+            headers["X-Deadline-Ms"] = str(max(1, int(remaining * 1000)))
+
+        if self._auth is not None:
+            h = self._auth.header(hb.url, method, path)
+            if h:
+                headers["Authorization"] = h
+
+        def call():
+            conn_rf, reused = self._pool.acquire(hb.url)
+            try:
+                status, raw, rhdrs = _request(conn_rf[0], conn_rf[1],
+                                              method, path, body,
+                                              headers, timeout)
+            except ConnectionError:
+                # a reused keep-alive socket died between requests (the
+                # replica restarted — a designed, supervised event): that
+                # is a property of THIS socket, not of the replica, so
+                # retry once on a fresh connection before letting the
+                # failure count against the breaker.  Internal queries
+                # are all idempotent reads.  Timeouts deliberately do
+                # NOT retry (a slow replica must cost one window, not
+                # two).
+                self._pool.discard(conn_rf)
+                if not reused:
+                    raise
+                self._pool.purge(hb.url)
+                conn_rf = self._pool.fresh(hb.url)
+                try:
+                    status, raw, rhdrs = _request(conn_rf[0], conn_rf[1],
+                                                  method, path, body,
+                                                  headers, timeout)
+                except BaseException:
+                    self._pool.discard(conn_rf)
+                    raise
+            except BaseException:
+                self._pool.discard(conn_rf)
+                raise
+            if status == 401 and self._auth is not None and \
+                    self._auth.challenge(
+                        hb.url, rhdrs.get("www-authenticate", "")):
+                # first contact, or the replica rotated its nonce set:
+                # answer the fresh challenge once on the same keep-alive
+                # connection (the 401 carries Content-Length: 0)
+                headers["Authorization"] = self._auth.header(
+                    hb.url, method, path)
+                try:
+                    status, raw, rhdrs = _request(conn_rf[0], conn_rf[1],
+                                                  method, path, body,
+                                                  headers, timeout)
+                except BaseException:
+                    self._pool.discard(conn_rf)
+                    raise
+            self._pool.release(hb.url, conn_rf)
+            payload = None
+            if raw:
+                try:
+                    payload = json.loads(raw)
+                except ValueError:
+                    payload = {"error": raw[:512].decode("latin-1")}
+            if status >= 500:
+                # replica answered but is unhealthy (lost its model,
+                # internal error): failover like a transport fault
+                raise ConnectionError(f"replica {hb.url} -> {status}")
+            return ShardResponse(shard, status, payload, hb.url)
+
+        return self._breaker(hb.url).call(call)
+
+    # -- hedged per-shard query ---------------------------------------------
+
+    def query_shard(self, shard: int, method: str, path: str,
+                    body: bytes | None = None,
+                    deadline: Deadline | None = None) -> ShardResponse:
+        """Authoritative response from ``shard``, via hedged attempts
+        over its live replicas; :class:`ShardUnavailable` when none
+        answers within the deadline."""
+        faults.fire("router-shard-timeout")
+        candidates = self.registry.candidates(shard)
+        if not candidates:
+            with self._lock:
+                self.shard_failures += 1
+            raise ShardUnavailable(f"shard {shard}: no live ready replica")
+        if len(candidates) == 1:
+            # nothing to hedge against: run the single attempt inline
+            # (per-request thread spawns are measurable at gateway qps)
+            try:
+                return self._attempt(candidates[0], shard, method, path,
+                                     body, deadline)
+            except ShardUnavailable:
+                with self._lock:
+                    self.shard_failures += 1
+                raise
+            except Exception as e:  # noqa: BLE001 — one shot only
+                with self._lock:
+                    self.shard_failures += 1
+                raise ShardUnavailable(
+                    f"shard {shard}: {type(e).__name__}: {e}") from e
+        box: SimpleQueue = SimpleQueue()
+        errors: list[BaseException] = []
+        in_flight = 0
+
+        def attempt_async(hb: Heartbeat) -> None:
+            def run():
+                try:
+                    box.put(self._attempt(hb, shard, method, path, body,
+                                          deadline))
+                except BaseException as e:  # noqa: BLE001 — collected
+                    box.put(e)
+            threading.Thread(target=run, daemon=True,
+                             name=f"router-hedge-s{shard}").start()
+
+        def drain(window: float | None) -> ShardResponse | None:
+            """Wait up to ``window`` (None = until deadline/timeout) for
+            a success; failures decrement in-flight and keep waiting."""
+            nonlocal in_flight
+            t_end = time.monotonic() + (window if window is not None
+                                        else self.shard_timeout_sec)
+            if deadline is not None:
+                t_end = min(t_end, deadline.t_end)
+            while in_flight:
+                wait = t_end - time.monotonic()
+                if wait <= 0:
+                    return None
+                try:
+                    got = box.get(timeout=wait)
+                except Empty:
+                    return None
+                in_flight -= 1
+                if isinstance(got, ShardResponse):
+                    return got
+                errors.append(got)
+                if isinstance(got, ShardUnavailable):
+                    # deadline exhausted inside the attempt: no point
+                    # waiting for more
+                    return None
+            return None
+
+        try:
+            for i, hb in enumerate(candidates[:self.max_attempts]):
+                if deadline is not None and deadline.expired:
+                    break
+                attempt_async(hb)
+                in_flight += 1
+                last = (i + 1 >= min(len(candidates), self.max_attempts))
+                res = drain(None if last else self.hedge_after_sec)
+                if res is not None:
+                    return res
+                if not last:
+                    with self._lock:
+                        self.hedges += 1
+            res = drain(None)
+            if res is not None:
+                return res
+        finally:
+            pass
+        with self._lock:
+            self.shard_failures += 1
+        detail = "; ".join(f"{type(e).__name__}: {e}" for e in errors[-3:])
+        raise ShardUnavailable(
+            f"shard {shard}: no replica answered ({detail or 'timeout'})")
+
+    # -- fan-out -------------------------------------------------------------
+
+    def scatter(self, method: str, paths: "dict[int, str] | str",
+                body: bytes | None = None,
+                deadline: Deadline | None = None,
+                shards: "Sequence[int] | None" = None
+                ) -> tuple[dict[int, ShardResponse], list[int]]:
+        """Query every shard — or only ``shards`` when given (e.g. the
+        Gramian cache fetching just the shards whose generation moved).
+        ``paths`` is one path for all shards or a per-shard map.
+        Returns (responses by shard, failed shards).  Raises
+        ShardUnavailable only when EVERY queried shard failed."""
+        targets = range(self.registry.shard_count) \
+            if shards is None else shards
+        futures = {
+            s: self._exec.submit(
+                self.query_shard, s,
+                method, paths if isinstance(paths, str) else paths[s],
+                body, deadline)
+            for s in targets}
+        results: dict[int, ShardResponse] = {}
+        failed: list[int] = []
+        # collection bound: the REQUEST deadline (plus a small grace for
+        # result plumbing), not the per-attempt transport cap — a shard
+        # stalled mid-attempt must degrade to a partial answer by the
+        # deadline, not hold the whole response for the transport cap
+        for s, f in futures.items():
+            try:
+                results[s] = f.result(
+                    timeout=self.shard_timeout_sec + 1.0
+                    if deadline is None
+                    else max(0.05, deadline.remaining()) + 0.25)
+            except Exception as e:  # noqa: BLE001 — shard drops out
+                _log.warning("shard %d dropped from merge: %s", s, e)
+                failed.append(s)
+        if not results:
+            raise ShardUnavailable(
+                f"all {len(futures)} queried shard(s) unavailable")
+        if failed:
+            with self._lock:
+                self.partial_answers += 1
+        return results, failed
+
+    def any_replica(self, method: str, path: str,
+                    body: bytes | None = None,
+                    deadline: Deadline | None = None) -> ShardResponse:
+        """Authoritative response from any ready replica (endpoints
+        answered from the replicated user store)."""
+        candidates = self.registry.any_candidates()
+        if not candidates:
+            raise ShardUnavailable("no live ready replica")
+        last: BaseException | None = None
+        for hb in candidates[:max(self.max_attempts, 1)]:
+            try:
+                return self._attempt(hb, hb.shard, method, path, body,
+                                     deadline)
+            except (ShardUnavailable, CircuitOpenError,
+                    OSError, ConnectionError, ValueError) as e:
+                last = e
+        raise ShardUnavailable(f"no replica answered: {last}")
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"hedges": self.hedges,
+                    "shard_failures": self.shard_failures,
+                    "partial_answers": self.partial_answers}

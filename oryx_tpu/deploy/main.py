@@ -7,6 +7,11 @@ on the built-in defaults), and the three ~10-line Main classes
 (deploy/oryx-batch/.../batch/Main.java etc.: construct layer from
 config, register shutdown hook, start, await).
 
+Beyond the reference's surface: ``warmup`` (install-time AOT compile),
+``serving --shard i/N`` (run one catalog shard of the serving
+cluster), and ``router`` (the cluster's scatter-gather public gateway
+— oryx_tpu/cluster/, docs/SCALING.md).
+
 Usage:
     python -m oryx_tpu <subcommand> [--conf my.conf] ...
 """
@@ -77,7 +82,25 @@ def _cmd_speed(args) -> int:
 def _cmd_serving(args) -> int:
     from ..lambda_rt.serving import ServingLayer
     config = _load_config(args.conf)
+    if getattr(args, "shard", None):
+        # replica mode of the sharded serving cluster: materialize one
+        # catalog slice, expose /shard/* scatter targets, heartbeat on
+        # the update topic (oryx_tpu/cluster/, docs/SCALING.md)
+        from ..cluster.sharding import parse_shard_spec
+        from ..common.config import from_dict
+        parse_shard_spec(args.shard)  # fail fast on a bad spec
+        config = from_dict({"oryx.cluster.enabled": True,
+                            "oryx.cluster.shard": args.shard}, config)
     _run_layer(lambda: ServingLayer(config), "serving", config)
+    return 0
+
+
+def _cmd_router(args) -> int:
+    """The scatter-gather gateway: public REST front end over a fleet
+    of shard replicas (cluster/router.py)."""
+    from ..cluster.router import RouterLayer
+    config = _load_config(args.conf)
+    _run_layer(lambda: RouterLayer(config), "router", config)
     return 0
 
 
@@ -203,6 +226,9 @@ def main(argv: list[str] | None = None) -> int:
             ("batch", _cmd_batch, "run the batch (training) layer"),
             ("speed", _cmd_speed, "run the speed (incremental) layer"),
             ("serving", _cmd_serving, "run the serving (REST) layer"),
+            ("router", _cmd_router,
+             "run the cluster gateway: scatter-gather router over "
+             "sharded serving replicas (see serving --shard)"),
             ("kafka-setup", _cmd_kafka_setup, "create/check topics"),
             ("kafka-tail", _cmd_kafka_tail, "print topic traffic"),
             ("kafka-input", _cmd_kafka_input, "send lines to input topic"),
@@ -215,6 +241,12 @@ def main(argv: list[str] | None = None) -> int:
         p = sub.add_parser(name, help=help_)
         p.add_argument("--conf", help="HOCON config file overlaying defaults")
         p.set_defaults(fn=fn)
+        if name == "serving":
+            p.add_argument("--shard", default=None, metavar="i/N",
+                           help="serve catalog shard i of N as a "
+                                "cluster replica (enables heartbeats "
+                                "+ /shard/* resources; front with "
+                                "'router')")
         if name == "kafka-tail":
             p.add_argument("--once", action="store_true",
                            help="drain current contents and exit")

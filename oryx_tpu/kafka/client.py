@@ -33,6 +33,7 @@ from typing import Iterator
 
 from ..resilience.policy import Backoff, Retry
 from .api import KeyMessage, TopicProducer
+from .partitioner import murmur2, partition_for_key
 from .wire import KafkaProtocolError, WireKafkaClient
 
 __all__ = ["kafka_client_available", "get_kafka_broker", "KafkaBroker",
@@ -81,37 +82,8 @@ def _dec(b: bytes | None) -> str | None:
     return None if b is None else b.decode("utf-8")
 
 
-def murmur2(data: bytes) -> int:
-    """Kafka's default partitioner hash (the Java client's murmur2):
-    keyed sends must land on the same partition as every other client
-    producing to a shared topic, or per-key ordering breaks."""
-    length = len(data)
-    seed = 0x9747B28C
-    m = 0x5BD1E995
-    mask = 0xFFFFFFFF
-    h = (seed ^ length) & mask
-    i = 0
-    for i in range(0, length - 3, 4):
-        k = int.from_bytes(data[i:i + 4], "little")
-        k = (k * m) & mask
-        k ^= k >> 24
-        k = (k * m) & mask
-        h = (h * m) & mask
-        h ^= k
-    left = length & 3
-    if left:
-        tail = data[length - left:]
-        if left >= 3:
-            h ^= tail[2] << 16
-        if left >= 2:
-            h ^= tail[1] << 8
-        h ^= tail[0]
-        h = (h * m) & mask
-    h ^= h >> 13
-    h = (h * m) & mask
-    h ^= h >> 15
-    return h
-
+# murmur2 lives in kafka/partitioner.py (shared with the in-proc broker
+# and the cluster's catalog sharding); re-exported here for back-compat.
 
 class KafkaBroker:
     """InProcBroker-surface adapter over the wire-protocol client."""
@@ -176,8 +148,7 @@ class KafkaBroker:
     def send(self, topic: str, key: str | None, message: str) -> int:
         parts = self._partitions(topic)
         if key is not None:
-            p = parts[(murmur2(key.encode("utf-8")) & 0x7FFFFFFF)
-                      % len(parts)]
+            p = parts[partition_for_key(key, len(parts))]
         else:
             with self._lock:
                 i = self._rr.get(topic, 0)

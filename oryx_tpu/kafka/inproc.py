@@ -12,7 +12,10 @@ Plays two roles, mirroring how the reference treats Kafka:
 2. The durable input/update log — topics are one or more append-only
    partition logs with monotonically increasing per-partition offsets;
    records with the same key always land in the same partition (keyed
-   crc32 partitioning, Kafka's contract), keyless records round-robin.
+   murmur2 partitioning — Kafka's DefaultPartitioner contract, shared
+   with the wire-protocol binding via kafka/partitioner.py so the same
+   key maps to the same partition on every backend), keyless records
+   round-robin.
    Consumers resume from committed per-(group, topic, partition)
    offsets (reference: per-partition consumer-offset storage in
    ZooKeeper, KafkaUtils.java:134-180) or replay from the beginning
@@ -37,13 +40,13 @@ import json
 import os
 import threading
 import time
-import zlib
 from concurrent.futures import ThreadPoolExecutor
 from typing import Iterator
 
 from ..common.io_utils import mkdirs
 from ..resilience import faults
 from .api import KeyMessage, TopicProducer
+from .partitioner import partition_for_key
 
 __all__ = ["InProcBroker", "get_broker", "resolve_broker", "InProcTopicProducer"]
 
@@ -226,7 +229,11 @@ class _Topic:
             with self._rr_lock:
                 self._rr = (self._rr + 1) % n
                 return self._rr
-        return zlib.crc32(key.encode("utf-8")) % n
+        # Kafka's DefaultPartitioner contract (shared with the wire
+        # binding): in-proc crc32 used to disagree with the wire
+        # client's murmur2, so the same key could land on different
+        # partitions depending on backend
+        return partition_for_key(key, n)
 
     def refresh_all(self) -> None:
         for p in self.partitions:

@@ -1,0 +1,60 @@
+"""Kafka's default keyed-partitioning contract, shared by every broker
+backend.
+
+The Java client's ``DefaultPartitioner`` routes a keyed record to
+``(murmur2(keyBytes) & 0x7fffffff) % numPartitions``
+(clients/src/main/java/org/apache/kafka/clients/producer/internals/
+DefaultPartitioner.java + Utils.murmur2).  Both the in-process broker
+(inproc.py) and the wire-protocol binding (client.py) resolve keys
+through :func:`partition_for_key`, so the same key lands on the same
+partition no matter which backend a layer happens to run against —
+the per-key ordering guarantee must not depend on deployment flavor.
+Golden vectors from the Kafka project's own test suite pin the hash in
+tests/test_kafka_conformance.py.
+
+This module is also the catalog-sharding hash of the serving cluster
+(oryx_tpu/cluster/): item id -> shard uses the identical
+``(murmur2 & 0x7fffffff) % n`` contract, so shard assignment is a
+stable, spec-pinned function of the id alone.
+"""
+
+from __future__ import annotations
+
+__all__ = ["murmur2", "partition_for_key"]
+
+
+def murmur2(data: bytes) -> int:
+    """Kafka's partitioner hash (the Java client's ``Utils.murmur2``),
+    returned as an unsigned 32-bit value (Java's signed int, masked)."""
+    length = len(data)
+    seed = 0x9747B28C
+    m = 0x5BD1E995
+    mask = 0xFFFFFFFF
+    h = (seed ^ length) & mask
+    i = 0
+    for i in range(0, length - 3, 4):
+        k = int.from_bytes(data[i:i + 4], "little")
+        k = (k * m) & mask
+        k ^= k >> 24
+        k = (k * m) & mask
+        h = (h * m) & mask
+        h ^= k
+    left = length & 3
+    if left:
+        tail = data[length - left:]
+        if left >= 3:
+            h ^= tail[2] << 16
+        if left >= 2:
+            h ^= tail[1] << 8
+        h ^= tail[0]
+        h = (h * m) & mask
+    h ^= h >> 13
+    h = (h * m) & mask
+    h ^= h >> 15
+    return h
+
+
+def partition_for_key(key: str, num_partitions: int) -> int:
+    """Partition index for a keyed record — Kafka's DefaultPartitioner
+    contract, byte-for-byte (positive-masked murmur2 modulo count)."""
+    return (murmur2(key.encode("utf-8")) & 0x7FFFFFFF) % num_partitions

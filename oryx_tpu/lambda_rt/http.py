@@ -373,18 +373,30 @@ class HttpApp:
     def _send(self, handler, result, head_only: bool, accept: str,
               gzip_ok: bool) -> None:
         status = 200
-        if isinstance(result, tuple) and len(result) == 2 \
+        extra_headers: dict[str, str] = {}
+        # handler results: value | (status, value) | (status, value,
+        # headers) — the 3-form lets resources attach response headers
+        # (the cluster gateway's X-Oryx-Partial degraded-answer marker)
+        if isinstance(result, tuple) and len(result) == 3 \
+                and isinstance(result[0], int) \
+                and isinstance(result[2], dict):
+            status, result, extra_headers = result
+        elif isinstance(result, tuple) and len(result) == 2 \
                 and isinstance(result[0], int):
             status, result = result
         if result is None:
             status = status if status != 200 else 204
             handler._oryx_status = status
             handler.send_response(status)
+            for k, v in extra_headers.items():
+                handler.send_header(k, v)
             handler.end_headers()
             return
         handler._oryx_status = status
         payload, ctype = json_or_csv(result, accept)
         handler.send_response(status)
+        for k, v in extra_headers.items():
+            handler.send_header(k, v)
         handler.send_header("Content-Type", ctype)
         if isinstance(result, HtmlResponse):
             # console pages carry anti-clickjacking + cache headers

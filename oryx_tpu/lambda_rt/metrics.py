@@ -62,10 +62,11 @@ class _RouteStats:
 
 
 class MetricsRegistry:
-    """Thread-safe per-route request stats."""
+    """Thread-safe per-route request stats + named event counters."""
 
     def __init__(self):
         self._routes: dict[str, _RouteStats] = {}
+        self._counters: dict[str, int] = {}
         self._lock = threading.Lock()
 
     def record(self, route: str, status: int, seconds: float) -> None:
@@ -74,6 +75,16 @@ class MetricsRegistry:
             if stats is None:
                 stats = self._routes[route] = _RouteStats()
             stats.record(status, seconds * 1000.0)
+
+    def inc(self, counter: str, by: int = 1) -> None:
+        """Bump a named cumulative counter (e.g. the cluster gateway's
+        ``partial_answers``); surfaced by counters_snapshot()."""
+        with self._lock:
+            self._counters[counter] = self._counters.get(counter, 0) + by
+
+    def counters_snapshot(self) -> dict:
+        with self._lock:
+            return dict(sorted(self._counters.items()))
 
     def snapshot(self) -> dict:
         """{route: {count, errors, mean_ms, p50_ms, p95_ms, p99_ms}}"""

@@ -15,6 +15,8 @@ import importlib
 import logging
 import threading
 
+from ..cluster.membership import HeartbeatPublisher, without_heartbeats
+from ..cluster.sharding import parse_shard_spec
 from ..common import compile_cache
 from ..common.config import Config
 from ..common.lang import load_instance, logging_call
@@ -65,6 +67,12 @@ class ServingLayer:
         self.no_init_topics = config.get_bool("oryx.serving.no-init-topics")
         self.min_model_load_fraction = config.get_double(
             "oryx.serving.min-model-load-fraction")
+        # serving-cluster replica mode (oryx_tpu/cluster/): this process
+        # serves one catalog shard, registers the internal /shard/*
+        # scatter targets, and announces itself on the update topic so
+        # the gateway routes to it
+        self.cluster_enabled = config.get_bool("oryx.cluster.enabled")
+        self.heartbeat: HeartbeatPublisher | None = None
 
         manager_class = config.get_string("oryx.serving.model-manager-class")
         self.model_manager = load_instance(manager_class, config)
@@ -95,6 +103,7 @@ class ServingLayer:
         routes = self._discover_routes()
         idle_ms = config.get_int(f"{api}.batch-idle-wait-ms")
         self.top_n_batcher = TopNBatcher(
+            max_batch=config.get_int(f"{api}.max-batch"),
             pipeline=config.get_int(f"{api}.scoring-pipeline-depth"),
             idle_wait_s=None if idle_ms < 0 else idle_ms / 1000.0)
         self.metrics = MetricsRegistry()
@@ -124,6 +133,11 @@ class ServingLayer:
         from ..serving import framework as framework_resources
 
         routes.extend(framework_resources.ROUTES)
+        if self.cluster_enabled:
+            # the gateway's internal scatter targets ride next to the
+            # public resources (same server, same auth/TLS)
+            from ..cluster import shard_resources
+            routes.extend(shard_resources.ROUTES)
         resources = self.config.get_optional_string(
             "oryx.serving.application-resources")
         if resources:
@@ -164,6 +178,24 @@ class ServingLayer:
             name="ServingLayerHTTP")
         self._server_thread.start()
         _log.info("Serving layer listening on port %d", self.port)
+        if self.cluster_enabled and self.update_broker and self.update_topic:
+            # announce this replica AFTER the port is bound (the
+            # heartbeat carries the live URL)
+            c = "oryx.cluster"
+            shard, of = parse_shard_spec(
+                self.config.get_optional_string(f"{c}.shard") or "0/1")
+            host = self.config.get_string(f"{c}.advertise-host")
+            self.heartbeat = HeartbeatPublisher(
+                InProcTopicProducer(self.update_broker, self.update_topic),
+                shard=shard, of=of,
+                url=f"{self.scheme}://{host}:{self.port}",
+                manager=self.model_manager,
+                min_fraction=self.min_model_load_fraction,
+                interval_sec=self.config.get_int(
+                    f"{c}.heartbeat-interval-ms") / 1000.0,
+                replica_id=self.config.get_optional_string(
+                    f"{c}.replica-id"))
+            self.heartbeat.start()
 
     def _consume_updates(self) -> None:
         # broker loss mid-tail resubscribes with backoff, replaying the
@@ -171,10 +203,12 @@ class ServingLayer:
         # (reference: auto.offset.reset=smallest), so the serving model
         # converges to the same state either way
         broker = resolve_broker(self.update_broker)
+        # cluster heartbeats share the update topic; they are control
+        # plane, not model state, and are filtered before the manager
         run_with_resubscribe(
-            lambda: self.model_manager.consume(
+            lambda: self.model_manager.consume(without_heartbeats(
                 broker.consume(self.update_topic, from_beginning=True,
-                               stop=self._stop)),
+                               stop=self._stop))),
             stop=self._stop, what="serving update consumer", log=_log)
 
     def await_(self) -> None:
@@ -183,6 +217,8 @@ class ServingLayer:
 
     def close(self) -> None:
         self._stop.set()
+        if self.heartbeat is not None:
+            self.heartbeat.close()
         if self._server:
             self._server.shutdown()
         self.top_n_batcher.close()

@@ -86,10 +86,13 @@ class SpeedLayer:
 
     def _consume_updates(self) -> None:
         broker = resolve_broker(self.update_broker)
+        # serving-cluster heartbeats ride the same update topic; they
+        # are control plane, filtered before the model manager
+        from ..cluster.membership import without_heartbeats
         run_with_resubscribe(
-            lambda: self.model_manager.consume(
+            lambda: self.model_manager.consume(without_heartbeats(
                 broker.consume(self.update_topic, from_beginning=True,
-                               stop=self._stop)),
+                               stop=self._stop))),
             stop=self._stop, what="speed update consumer", log=_log)
 
     def _micro_batch_loop(self) -> None:

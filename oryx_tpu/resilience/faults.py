@@ -31,7 +31,10 @@ actually happened rather than trusting that it did.
 
 Point names use dashes (``batch-crash-before-commit``), never dots, so
 they stay addressable as single HOCON keys under
-``oryx.resilience.faults``.
+``oryx.resilience.faults``.  docs/RESILIENCE.md tables every live
+point, including the serving-cluster seams (``router-shard-timeout``,
+``replica-heartbeat-drop``) that drive the gateway's partial-answer
+chaos tests.
 """
 
 from __future__ import annotations

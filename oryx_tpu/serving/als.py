@@ -33,7 +33,12 @@ from ..ops import als_fold_in
 from . import console
 from .framework import get_serving_model, send_input
 
-__all__ = ["ROUTES", "IDValue", "IDCount"]
+# IDValue/IDCount and the param/path parsing helpers are also the
+# cluster gateway's vocabulary (cluster/router.py re-serves this
+# surface via scatter-gather): exported so that reuse is a contract,
+# not a reach into private names
+__all__ = ["ROUTES", "IDValue", "IDCount", "parse_id_value_segments",
+           "how_many_offset"]
 
 
 @dataclasses.dataclass
@@ -97,6 +102,15 @@ def _slice(pairs: list[tuple[str, float]], how_many: int,
 def _check_exists(cond: bool, what: str) -> None:
     if not cond:
         raise OryxServingException(404, what)
+
+
+# public aliases of the parsing helpers (the gateway's imports)
+def how_many_offset(req: Request) -> tuple[int, int]:
+    return _how_many_offset(req)
+
+
+def parse_id_value_segments(raw: str) -> list[tuple[str, float]]:
+    return _parse_id_value_segments(raw)
 
 
 def _parse_id_value_segments(raw: str) -> list[tuple[str, float]]:

@@ -33,6 +33,7 @@ from typing import Iterable
 
 import numpy as np
 
+from ..resilience import faults
 from ..resilience.policy import Deadline, DeadlineExceeded
 
 __all__ = ["TopNBatcher"]
@@ -300,6 +301,19 @@ class TopNBatcher:
                     "request deadline expired while queued")
                 j.done.set()
             jobs = [j for j in jobs if j.error is None]
+        # chaos / device-emulation seam: one fire per drained dispatch.
+        # mode=delay stands in for per-dispatch device time the host
+        # does not burn CPU on — bench/gateway.py stages it to model
+        # fixed-rate accelerators on a shared CPU box; mode=error fails
+        # the whole drain (surfaced per job, never killing the
+        # dispatcher thread)
+        try:
+            faults.fire("serving-scan-dispatch")
+        except Exception as e:  # noqa: BLE001 — injected
+            for j in jobs:
+                j.error = e
+                j.done.set()
+            return 0
         by_model: dict[int, list[_Job]] = {}
         for j in jobs:
             by_model.setdefault(id(j.model), []).append(j)

@@ -97,6 +97,20 @@ def _metrics(req: Request):
     batcher = req.context.get("top_n_batcher")
     if batcher is not None:
         out["scoring_batcher"] = batcher.stats()
+    counters = registry.counters_snapshot()
+    if counters:
+        out["counters"] = counters
+    # sharded-cluster replica: shard coordinates + generation, so an
+    # operator (and the gateway bench) can see per-replica catalog
+    # state without the router in between
+    mgr = req.context["model_manager"]
+    if getattr(mgr, "shard_count", 1) > 1 or hasattr(mgr, "generation"):
+        cluster = {"generation": getattr(mgr, "generation", 0)}
+        if getattr(mgr, "shard_count", 1) > 1:
+            cluster.update(shard=mgr.shard_index, of=mgr.shard_count,
+                           skipped_remote_items=getattr(
+                               mgr, "skipped_remote_items", 0))
+        out["cluster"] = cluster
     # named retry / circuit-breaker counters (resilience.policy) — the
     # evidence surface for "is the breaker open, how often do we retry"
     out["resilience"] = resilience_snapshot()

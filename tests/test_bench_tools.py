@@ -195,3 +195,65 @@ def test_warmup_cli_reports_compiles(tmp_path):
     assert report["metric"] == "aot_warmup"
     assert report["compiled_count"] > 0
     assert report["cache_dir"] == str(tmp_path / "cache")
+
+
+# -- gateway scaling regression gate (ISSUE 4 satellite) ---------------------
+
+def _gateway_doc(cells, backend="cpu"):
+    return {"metric": "gateway_recommend_scaling", "backend": backend,
+            "rows": [{"features": f, "items": i, "replicas": n,
+                      "open_loop_sustained_qps": qps,
+                      "merge_spotcheck_ok": True}
+                     for (f, i, n, qps) in cells]}
+
+
+def test_check_regression_gateway_passes_and_reports_cells(tmp_path,
+                                                           capsys):
+    prev = _gateway_doc([(50, 65536, 1, 100.0), (50, 65536, 2, 170.0)])
+    cur = _gateway_doc([(50, 65536, 1, 98.0), (50, 65536, 2, 200.0)])
+    rc = cr.main(["--kind", "gateway",
+                  "--previous", _write(tmp_path,
+                                       "BENCH_GATEWAY_r07.json", prev),
+                  "--current", _write(tmp_path,
+                                      "BENCH_GATEWAY_r08.json", cur)])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out)
+    assert not report["regressions"]
+    assert {c["cell"] for c in report["ok"] + report["improved"]} == \
+        {"50f/0.065536M/1rep", "50f/0.065536M/2rep"}
+
+
+def test_check_regression_gateway_fails_on_per_replica_cell_drop(
+        tmp_path, capsys):
+    """The 2-replica cell dropping >10% fails even when the 1-replica
+    cell held — scaling regressions gate per replica count."""
+    prev = _gateway_doc([(50, 65536, 1, 100.0), (50, 65536, 2, 170.0)])
+    cur = _gateway_doc([(50, 65536, 1, 101.0), (50, 65536, 2, 140.0)])
+    rc = cr.main(["--kind", "gateway",
+                  "--previous", _write(tmp_path,
+                                       "BENCH_GATEWAY_r07.json", prev),
+                  "--current", _write(tmp_path,
+                                      "BENCH_GATEWAY_r08.json", cur)])
+    assert rc == 1
+    report = json.loads(capsys.readouterr().out)
+    assert [c["cell"] for c in report["regressions"]] == \
+        ["50f/0.065536M/2rep"]
+
+
+def test_check_regression_gateway_discovers_rounds_and_skips_cross_backend(
+        tmp_path, capsys):
+    _write(tmp_path, "BENCH_GATEWAY_r07.json",
+           _gateway_doc([(50, 65536, 2, 170.0)], backend="cpu"))
+    _write(tmp_path, "BENCH_GATEWAY_r08.json",
+           _gateway_doc([(50, 65536, 2, 100.0)], backend="cpu"))
+    # grid artifacts in the same dir must not be picked up
+    _write(tmp_path, "BENCH_GRID_r09.json", _grid_doc([]))
+    rc = cr.main(["--kind", "gateway", "--dir", str(tmp_path)])
+    assert rc == 1
+    report = json.loads(capsys.readouterr().out)
+    assert report["previous"] == "BENCH_GATEWAY_r07.json"
+    assert report["current"] == "BENCH_GATEWAY_r08.json"
+    # cross-backend rounds never compare
+    _write(tmp_path, "BENCH_GATEWAY_r09.json",
+           _gateway_doc([(50, 65536, 2, 1.0)], backend="tpu"))
+    assert cr.main(["--kind", "gateway", "--dir", str(tmp_path)]) == 0

@@ -1,0 +1,603 @@
+"""Sharded serving cluster integration tests (ISSUE 4 acceptance):
+a 2-replica cluster over the in-proc broker proves
+
+1. router top-N ≡ single-node exact top-N (ids and order, values to
+   float tolerance) across the public endpoint surface;
+2. kill one replica → partial answer (``X-Oryx-Partial: shards=1/2``,
+   HTTP 200, within deadline) → rejoin → exact again, all WITHOUT a
+   router restart;
+3. the chaos fault points: ``router-shard-timeout`` (a stalled shard
+   degrades to a partial answer inside the request deadline) and
+   ``replica-heartbeat-drop`` (a silent replica ages out of routing,
+   returns when heartbeats resume);
+4. hedged failover: with two replicas of the same shard, a dead-but-
+   not-yet-aged-out replica's failure fails over inside one request.
+
+Marker: chaos (in the tier-1 budget).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from oryx_tpu.cluster.router import RouterLayer
+from oryx_tpu.common.config import from_dict
+from oryx_tpu.kafka.inproc import get_broker
+from oryx_tpu.lambda_rt.batch import BatchLayer
+from oryx_tpu.lambda_rt.serving import ServingLayer
+from oryx_tpu.resilience import faults
+from oryx_tpu.resilience.policy import Deadline
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _config(tmp_path, broker_name, **extra):
+    overlay = {
+        "oryx.id": "cluster-it",
+        "oryx.input-topic.broker": f"memory://{broker_name}",
+        "oryx.input-topic.partitions": 1,
+        "oryx.input-topic.message.topic": "CIn",
+        "oryx.update-topic.broker": f"memory://{broker_name}",
+        "oryx.update-topic.message.topic": "CUp",
+        "oryx.batch.update-class": "oryx_tpu.app.als.update.ALSUpdate",
+        "oryx.serving.model-manager-class":
+            "oryx_tpu.app.als.serving_manager.ALSServingModelManager",
+        "oryx.serving.application-resources": "oryx_tpu.serving.als",
+        "oryx.batch.storage.data-dir": str(tmp_path / "data"),
+        "oryx.batch.storage.model-dir": str(tmp_path / "model"),
+        "oryx.als.iterations": 2,
+        "oryx.als.implicit": True,
+        "oryx.als.hyperparams.features": 3,
+        "oryx.ml.eval.test-fraction": 0.0,
+        # fast cluster timings so membership transitions stay inside
+        # the tier-1 budget
+        "oryx.cluster.heartbeat-interval-ms": 60,
+        "oryx.cluster.heartbeat-ttl-ms": 400,
+        "oryx.cluster.hedge-after-ms": 50,
+        "oryx.cluster.shard-timeout-ms": 5000,
+        "oryx.resilience.retry.max-attempts": 2,
+        "oryx.resilience.retry.initial-backoff-ms": 1,
+        "oryx.resilience.retry.max-backoff-ms": 2,
+        "oryx.resilience.breaker.reset-timeout-ms": 50,
+    }
+    overlay.update(extra)
+    return from_dict(overlay)
+
+
+def _produce_ratings(broker, topic, nu=20, ni=14, seed=9):
+    rng = np.random.default_rng(seed)
+    t = 1_700_000_000_000
+    for u in range(nu):
+        for i in range(ni):
+            if rng.random() < 0.45:
+                broker.send(topic, None,
+                            f"u{u},i{i},{rng.exponential(1):.2f},{t}")
+                t += 1000
+    # one id that is BOTH a user and an item: X and Y are independent
+    # stores single-node, so "dual" must resolve per-store everywhere
+    for line in ("dual,i0,1.5", "dual,i3,0.7", "u0,dual,2.0",
+                 "u3,dual,0.9", "dual,dual,1.0"):
+        broker.send(topic, None, f"{line},{t}")
+        t += 1000
+
+
+def _get(port, path, headers=None, timeout=15):
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}",
+                                 headers=headers or {})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, dict(r.headers), json.loads(r.read() or b"null")
+
+
+def _await(predicate, what, timeout=25.0):
+    deadline = Deadline.after(timeout)
+    while not deadline.expired:
+        if predicate():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _router_ready(router):
+    try:
+        return _get(router.port, "/ready")[0] in (200, 204)
+    except (urllib.error.HTTPError, urllib.error.URLError, OSError):
+        return False
+
+
+def _start_replica(cfg_fn, shard, of, replica_id=None, extra=None):
+    overlay = {"oryx.cluster.enabled": True,
+               "oryx.cluster.shard": f"{shard}/{of}"}
+    overlay.update(extra or {})
+    if replica_id:
+        overlay["oryx.cluster.replica-id"] = replica_id
+    layer = ServingLayer(cfg_fn(overlay), port=0)
+    layer.start()
+    return layer
+
+
+def _ids(payload):
+    return [d["id"] for d in payload]
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    """One shared 2-shard cluster + single-node reference + router."""
+    tmp_path = tmp_path_factory.mktemp("cluster-it")
+    broker = get_broker("cluster-it")
+    _produce_ratings(broker, "CIn")
+
+    def cfg_fn(extra=None):
+        return _config(tmp_path, "cluster-it", **(extra or {}))
+
+    BatchLayer(cfg_fn()).run_one_generation()
+    replicas = [_start_replica(cfg_fn, s, 2) for s in range(2)]
+    single = ServingLayer(cfg_fn(), port=0)
+    single.start()
+    router = RouterLayer(cfg_fn(), port=0)
+    router.start()
+    _await(lambda: _router_ready(router), "router readiness")
+    _await(lambda: (m := single.model_manager.get_model()) is not None
+           and m.get_fraction_loaded() >= 0.8, "single-node model")
+    yield {"cfg_fn": cfg_fn, "replicas": replicas, "single": single,
+           "router": router, "broker": broker}
+    for layer in replicas + [single, router]:
+        try:
+            layer.close()
+        except Exception:  # noqa: BLE001 — teardown best effort
+            pass
+
+
+def test_router_top_n_equals_single_node_exact(cluster):
+    single, router = cluster["single"], cluster["router"]
+    model = single.model_manager.get_model()
+    users = sorted(model.all_user_ids())
+    assert users
+    for uid in users:
+        for hm in (3, 10):
+            _, h1, r1 = _get(router.port, f"/recommend/{uid}?howMany={hm}")
+            _, _, r2 = _get(single.port, f"/recommend/{uid}?howMany={hm}")
+            assert h1.get("X-Oryx-Partial") is None
+            assert _ids(r1) == _ids(r2), uid
+            for a, b in zip(r1, r2):
+                # scores are the same f32 dot up to kernel-shape
+                # rounding: tolerance must be absolute near zero
+                assert a["value"] == pytest.approx(b["value"], rel=1e-5,
+                                                   abs=1e-6)
+
+
+def test_router_wider_endpoint_surface_matches_single_node(cluster):
+    single, router = cluster["single"], cluster["router"]
+    model = single.model_manager.get_model()
+    uid = sorted(model.all_user_ids())[0]
+    i1, i2 = sorted(model.all_item_ids())[:2]
+    # identical payloads end-to-end
+    for path in (f"/similarity/{i1}/{i2}",
+                 f"/similarityToItem/{i1}/{i2}",
+                 f"/estimate/{uid}/{i1}/{i2}",
+                 f"/because/{uid}/{i1}",
+                 f"/mostSurprising/{uid}",
+                 "/mostPopularItems", "/mostActiveUsers",
+                 "/allUserIDs", f"/knownItems/{uid}",
+                 "/popularRepresentativeItems"):
+        _, _, r1 = _get(router.port, path)
+        _, _, r2 = _get(single.port, path)
+        assert r1 == r2, path
+    # recommendToMany: exact ids/order; scores may differ in the last
+    # ulp (the fetch-window shape rounds the same dot differently)
+    _, _, r1 = _get(router.port, f"/recommendToMany/{uid}")
+    _, _, r2 = _get(single.port, f"/recommendToMany/{uid}")
+    assert _ids(r1) == _ids(r2)
+    for a, b in zip(r1, r2):
+        assert a["value"] == pytest.approx(b["value"], rel=1e-5, abs=1e-6)
+    # catalog enumeration: same set (order is shard-interleaved)
+    _, _, r1 = _get(router.port, "/allItemIDs")
+    _, _, r2 = _get(single.port, "/allItemIDs")
+    assert sorted(r1) == sorted(r2)
+    # fold-in endpoints: the router solves against the SUMMED shard
+    # Gramians — same ids, values to solver tolerance
+    for path in (f"/recommendToAnonymous/{i1}=2.0/{i2}",
+                 f"/recommendWithContext/{uid}/{i1}=1.5"):
+        _, _, r1 = _get(router.port, path)
+        _, _, r2 = _get(single.port, path)
+        assert _ids(r1) == _ids(r2), path
+        for a, b in zip(r1, r2):
+            assert a["value"] == pytest.approx(b["value"], rel=1e-4)
+    _, _, v1 = _get(router.port, f"/estimateForAnonymous/{i1}/{i2}")
+    _, _, v2 = _get(single.port, f"/estimateForAnonymous/{i1}/{i2}")
+    assert v1 == pytest.approx(v2, rel=1e-4)
+    # 404 parity
+    for path in ("/recommend/nosuchuser", f"/estimate/nosuchuser/{i1}",
+                 f"/similarity/nosuchitem/{i1}"):
+        with pytest.raises(urllib.error.HTTPError) as e1:
+            _get(router.port, path)
+        with pytest.raises(urllib.error.HTTPError) as e2:
+            _get(single.port, path)
+        assert e1.value.code == e2.value.code == 404, path
+
+
+def test_estimate_with_user_item_id_collision(cluster):
+    """'dual' names both a user and an item: the router must pair the
+    USER vector with the ITEM vector, not whichever one happened to
+    land last in a shared id map (xu·xu instead of xu·y)."""
+    single, router = cluster["single"], cluster["router"]
+    for path in ("/estimate/dual/dual", "/estimate/dual/dual/i0",
+                 "/recommend/dual?howMany=5"):
+        _, _, r1 = _get(router.port, path)
+        _, _, r2 = _get(single.port, path)
+        if isinstance(r1, list) and r1 and isinstance(r1[0], dict):
+            assert _ids(r1) == _ids(r2), path
+            for a, b in zip(r1, r2):
+                assert a["value"] == pytest.approx(b["value"], rel=1e-5,
+                                                   abs=1e-6)
+        else:
+            assert r1 == pytest.approx(r2, rel=1e-5, abs=1e-6), path
+
+
+def _publish_synthetic_model(broker, topic, n_users=4, n_items=10,
+                             features=3, seed=3):
+    """MODEL + UP straight onto the update topic: replicas load through
+    their normal replay path, no batch run needed."""
+    from oryx_tpu.common import pmml as pmml_io
+    from oryx_tpu.kafka.api import KEY_MODEL, KEY_UP
+
+    # "sp ace" exercises percent-encoded ids across the internal hop
+    users = [f"au{j}" for j in range(n_users)] + ["sp ace"]
+    items = [f"ai{j}" for j in range(n_items)]
+    doc = pmml_io.build_skeleton_pmml()
+    pmml_io.add_extension(doc, "features", features)
+    pmml_io.add_extension(doc, "implicit", True)
+    pmml_io.add_extension_content(doc, "XIDs", users)
+    pmml_io.add_extension_content(doc, "YIDs", items)
+    broker.send(topic, KEY_MODEL, pmml_io.to_string(doc))
+    rng = np.random.default_rng(seed)
+    for iid in items:
+        broker.send(topic, KEY_UP, json.dumps(
+            ["Y", iid, [float(x) for x in rng.standard_normal(features)]]))
+    for uid in users:
+        broker.send(topic, KEY_UP, json.dumps(
+            ["X", uid, [float(x) for x in rng.standard_normal(features)],
+             []]))
+
+
+def test_digest_auth_secures_public_and_scatter_hops(tmp_path):
+    """DIGEST credentials in one shared conf: the router challenges the
+    public client AND answers the replicas' challenge on the internal
+    scatter hop with the same credentials — a 200 with rows through the
+    router proves both hops."""
+    broker = get_broker("cluster-auth")
+    _publish_synthetic_model(broker, "CUp")
+
+    auth = {"oryx.serving.api.user-name": "oryx-admin",
+            "oryx.serving.api.password": "s3cret"}
+
+    def cfg_fn(extra=None):
+        return _config(tmp_path, "cluster-auth", **{**auth, **(extra or {})})
+
+    replica = _start_replica(cfg_fn, 0, 1)
+    router = RouterLayer(cfg_fn(), port=0)
+    router.start()
+    try:
+        pm = urllib.request.HTTPPasswordMgrWithDefaultRealm()
+        for port in (router.port, replica.port):
+            pm.add_password(None, f"http://127.0.0.1:{port}/",
+                            "oryx-admin", "s3cret")
+        opener = urllib.request.build_opener(
+            urllib.request.HTTPDigestAuthHandler(pm))
+
+        def dget(port, path):
+            with opener.open(f"http://127.0.0.1:{port}{path}",
+                             timeout=15) as r:
+                return r.status, dict(r.headers), json.loads(
+                    r.read() or b"null")
+
+        _await(lambda: dget(replica.port, "/shard/meta")[2]["ready"],
+               "auth replica model load")
+        _await(lambda: _safe(lambda: dget(
+            router.port, "/ready")[0] in (200, 204)),
+            "auth router readiness")
+        # unauthenticated: challenged at the public door
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(router.port, "/recommend/au0")
+        assert e.value.code == 401
+        # authenticated: full scatter-gather through the DIGEST-
+        # enforcing replica
+        status, headers, rows = dget(router.port,
+                                     "/recommend/au0?howMany=5")
+        assert status == 200 and headers.get("X-Oryx-Partial") is None
+        assert len(rows) == 5
+        # byte-identical to the replica's own (authenticated) answer
+        _, _, local = dget(replica.port,
+                           "/shard/recommend/au0?howMany=5")
+        assert _ids(rows) == [r[0] for r in local["rows"][:5]]
+        # percent-encoded id through the proxied user-store hop: the
+        # router must RE-quote the decoded path on the internal wire
+        status, _, known = dget(router.port, "/knownItems/sp%20ace")
+        assert status == 200 and known == []
+    finally:
+        for layer in (router, replica):
+            try:
+                layer.close()
+            except Exception:  # noqa: BLE001 — teardown best effort
+                pass
+
+
+def _safe(fn):
+    try:
+        return fn()
+    except (urllib.error.HTTPError, urllib.error.URLError, OSError):
+        return False
+
+
+def test_stale_keepalive_socket_retries_on_fresh_connection(tmp_path):
+    """A pooled keep-alive socket whose replica restarted (supervised
+    restart is a designed event) must retry once on a fresh connection
+    — a dead socket is a property of the pool, not a shard failure."""
+    import http.server
+    import threading
+
+    from oryx_tpu.cluster.membership import Heartbeat, MembershipRegistry
+    from oryx_tpu.cluster.scatter import ScatterGather
+
+    class H(http.server.BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def do_GET(self):
+            body = b'{"rows": []}'
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    def start(port=0):
+        srv = http.server.ThreadingHTTPServer(("127.0.0.1", port), H)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return srv
+
+    srv = start()
+    port = srv.server_address[1]
+    reg = MembershipRegistry(ttl_sec=60.0)
+    reg.note(Heartbeat(replica="r", shard=0, of=1,
+                       url=f"http://127.0.0.1:{port}", generation=1,
+                       ready=True))
+    sg = ScatterGather(reg, _config(tmp_path, "stale-conn"))
+    try:
+        assert sg.query_shard(0, "GET", "/x").ok  # pools the socket
+        srv.shutdown()
+        srv.server_close()
+        srv2 = start(port)  # replica back on the same URL
+        assert sg.query_shard(0, "GET", "/x").ok  # stale → fresh retry
+        assert sg.shard_failures == 0
+        srv2.shutdown()
+        srv2.server_close()
+    finally:
+        sg.close()
+
+
+def test_tls_replicas_behind_plain_router(tmp_path):
+    """Replicas serving HTTPS (self-signed, the cluster-internal trust
+    model): their heartbeats advertise https:// URLs and the router's
+    scatter transport must speak TLS to them."""
+    from tests.test_serving import _self_signed_pem  # skips w/o package
+    pem = _self_signed_pem(tmp_path)
+    broker = get_broker("cluster-tls")
+    _publish_synthetic_model(broker, "CUp")
+
+    def cfg_fn(extra=None):
+        return _config(tmp_path, "cluster-tls", **(extra or {}))
+
+    replica = _start_replica(
+        cfg_fn, 0, 1, extra={"oryx.serving.api.keystore-file": pem})
+    assert replica.scheme == "https"
+    router = RouterLayer(cfg_fn(), port=0)  # plain-HTTP public door
+    router.start()
+    try:
+        import ssl
+        ctx = ssl._create_unverified_context()
+
+        def sget(path):
+            req = urllib.request.Request(
+                f"https://127.0.0.1:{replica.port}{path}")
+            with urllib.request.urlopen(req, timeout=15,
+                                        context=ctx) as r:
+                return json.loads(r.read() or b"null")
+
+        _await(lambda: sget("/shard/meta")["ready"],
+               "tls replica model load")
+        _await(lambda: _router_ready(router), "tls router readiness")
+        status, headers, rows = _get(router.port,
+                                     "/recommend/au0?howMany=5")
+        assert status == 200 and headers.get("X-Oryx-Partial") is None
+        assert len(rows) == 5
+        local = sget("/shard/recommend/au0?howMany=5")
+        assert _ids(rows) == [r[0] for r in local["rows"][:5]]
+    finally:
+        for layer in (router, replica):
+            try:
+                layer.close()
+            except Exception:  # noqa: BLE001 — teardown best effort
+                pass
+
+
+def test_kill_replica_partial_then_rejoin_exact(cluster):
+    """The headline acceptance scenario, all through ONE router with no
+    restart: kill → 200 + X-Oryx-Partial within deadline → rejoin →
+    exact."""
+    single, router = cluster["single"], cluster["router"]
+    cfg_fn = cluster["cfg_fn"]
+    from oryx_tpu.cluster.sharding import shard_of
+    model = single.model_manager.get_model()
+    uid = sorted(model.all_user_ids())[0]
+    _, _, full = _get(single.port, f"/recommend/{uid}?howMany=6")
+    full_ids = _ids(full)
+    victim = cluster["replicas"][1]
+    victim.close()
+    try:
+        # after the TTL the shard is uncovered: partial answers, never
+        # errors or hangs
+        def partial_seen():
+            _, h, _ = _get(router.port, f"/recommend/{uid}?howMany=6",
+                           headers={"X-Deadline-Ms": "10000"})
+            return h.get("X-Oryx-Partial") == "shards=1/2"
+        _await(partial_seen, "partial answer after replica kill")
+
+        t0 = time.monotonic()
+        status, headers, partial = _get(
+            router.port, f"/recommend/{uid}?howMany=6",
+            headers={"X-Deadline-Ms": "10000"})
+        elapsed = time.monotonic() - t0
+        assert status == 200
+        assert headers.get("X-Oryx-Partial") == "shards=1/2"
+        assert elapsed < 10.0  # answered within the propagated deadline
+        # the partial answer is EXACT over the surviving catalog: the
+        # single-node global ranking restricted to shard-0 items
+        _, _, full_deep = _get(single.port,
+                               f"/recommend/{uid}?howMany=100")
+        survivors = [i for i in _ids(full_deep) if shard_of(i, 2) == 0]
+        assert _ids(partial) == survivors[:len(_ids(partial))]
+        # readiness reflects the uncovered shard
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(router.port, "/ready")
+        assert exc.value.code == 503
+        # counted on /metrics
+        _, _, m = _get(router.port, "/metrics")
+        assert m["counters"]["partial_answers"] >= 1
+        assert m["cluster"]["covered_shards"] == [0]
+    finally:
+        # rejoin: a fresh replica of the killed shard, same topic
+        # replay (in finally, so a failing assertion above cannot
+        # leave the shared cluster half-dead for later tests)
+        cluster["replicas"][1] = _start_replica(cfg_fn, 1, 2)
+    _await(lambda: _router_ready(router), "rejoin readiness")
+
+    def exact_again():
+        _, h, r1 = _get(router.port, f"/recommend/{uid}?howMany=6")
+        return h.get("X-Oryx-Partial") is None and _ids(r1) == full_ids
+    _await(exact_again, "exact answers after rejoin")
+
+
+def test_router_shard_timeout_fault_degrades_to_partial(cluster):
+    """Chaos point ``router-shard-timeout``: one shard query stalls
+    past the request deadline — the router answers from the survivors
+    within the deadline instead of hanging."""
+    single, router = cluster["single"], cluster["router"]
+    model = single.model_manager.get_model()
+    uid = sorted(model.all_user_ids())[0]
+    _, _, before = _get(router.port, "/metrics")
+    faults.inject("router-shard-timeout", mode="delay", times=1,
+                  delay_sec=2.0)
+    t0 = time.monotonic()
+    status, headers, _ = _get(router.port, f"/recommend/{uid}?howMany=6",
+                              headers={"X-Deadline-Ms": "900"})
+    elapsed = time.monotonic() - t0
+    assert status == 200
+    assert headers.get("X-Oryx-Partial") == "shards=1/2"
+    assert elapsed < 2.0  # did not wait out the stall
+    assert faults.fired("router-shard-timeout") == 1
+    _, _, after = _get(router.port, "/metrics")
+    assert after["counters"]["partial_answers"] > \
+        before["counters"].get("partial_answers", 0)
+
+
+def test_heartbeat_drop_ages_replica_out_and_back(cluster):
+    """Chaos point ``replica-heartbeat-drop``: a replica that stays up
+    but stops heartbeating (partitioned from the broker) must age out
+    of routing — partial answers — and return once heartbeats resume,
+    with no restarts anywhere."""
+    single, router = cluster["single"], cluster["router"]
+    model = single.model_manager.get_model()
+    uid = sorted(model.all_user_ids())[0]
+    faults.inject("replica-heartbeat-drop", mode="drop", times=None)
+    # BOTH replicas go silent -> no live replica -> 503 (not a hang)
+    def all_aged_out():
+        try:
+            _get(router.port, f"/recommend/{uid}?howMany=4",
+                 headers={"X-Deadline-Ms": "3000"})
+            return False
+        except urllib.error.HTTPError as e:
+            return e.code == 503
+    _await(all_aged_out, "silent replicas aged out")
+    assert faults.fired("replica-heartbeat-drop") > 0
+    faults.clear("replica-heartbeat-drop")
+
+    def recovered():
+        try:
+            _, h, _ = _get(router.port, f"/recommend/{uid}?howMany=4")
+            return h.get("X-Oryx-Partial") is None
+        except urllib.error.HTTPError:
+            return False
+    _await(recovered, "heartbeats resumed")
+
+
+def test_hedged_failover_within_replica_ttl(cluster):
+    """Two replicas of shard 0: kill one WITHOUT waiting for its TTL —
+    the very next request fails over (connection refused -> hedge to
+    the sibling) and still answers exactly."""
+    single, router = cluster["single"], cluster["router"]
+    cfg_fn = cluster["cfg_fn"]
+    model = single.model_manager.get_model()
+    uid = sorted(model.all_user_ids())[0]
+    extra = _start_replica(cfg_fn, 0, 2, replica_id="shard0-sibling")
+    try:
+        _await(lambda: len(_get(router.port, "/metrics")[2]["cluster"]
+                           ["membership"]["replicas"]) >= 3,
+               "sibling registered")
+        extra.close()  # dead but still inside its TTL window
+        _, _, expected = _get(single.port, f"/recommend/{uid}?howMany=5")
+        # several requests in a row: whichever candidate order the
+        # rotation picks, failover must hide the dead sibling
+        for _ in range(6):
+            status, h, got = _get(router.port,
+                                  f"/recommend/{uid}?howMany=5",
+                                  headers={"X-Deadline-Ms": "8000"})
+            assert status == 200
+            assert h.get("X-Oryx-Partial") is None
+            assert _ids(got) == _ids(expected)
+    finally:
+        try:
+            extra.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def test_write_path_flows_through_router_to_input_topic(cluster):
+    router, broker = cluster["router"], cluster["broker"]
+    end_before = broker.latest_offset("CIn")
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{router.port}/pref/u0/i1", data=b"2.5",
+        method="POST")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert r.status in (200, 204)
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{router.port}/ingest",
+        data=b"u1,i2,1.0\nu2,i3,0.5\n", method="POST")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert r.status == 200
+    assert broker.latest_offset("CIn") == end_before + 3
+
+
+def test_router_metrics_surface(cluster):
+    router = cluster["router"]
+    _, _, m = _get(router.port, "/metrics")
+    assert m["cluster"]["membership"]["shards"] == 2
+    assert any(r["shard"] == 0 and r["live"] for r in
+               m["cluster"]["membership"]["replicas"].values())
+    assert "GET /recommend/{userID}" in m["routes"]
+    assert "scatter" in m["cluster"]
+    # per-replica breakers are registered under the resilience surface
+    assert any(k.startswith("router-replica[") for k in m["resilience"])

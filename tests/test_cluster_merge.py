@@ -1,0 +1,482 @@
+"""Cluster unit + property tests: sharding, membership, and the exact
+top-N merge (ISSUE 4 satellite: random catalogs / shardings / ties /
+retired rows, merged scatter-gather top-N byte-identical — ids and
+order — to the single-node exact scan, including the rescorer path).
+
+The property tests drive N sharded ALSServingModelManagers and one
+full (0/1) manager through the IDENTICAL simulated update-topic
+stream — the same totally-ordered replay real replicas consume — then
+compare ``merge(shards)`` against the single node AND against an
+independent brute-force numpy oracle.  Factor values are multiples of
+1/4 at 4 features, so every dot product is an exact multiple of 1/16
+in float32: scores are bit-identical no matter which kernel/shape
+computed them, and the byte-identical claim is deterministic, not
+rounding-lucky.  Ties are real (duplicate vectors), and retired rows
+recycle store rows differently in every process — exactly the row
+order divergence the canonical (score, ordinal, id) order exists to
+neutralize.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from oryx_tpu.app.als.rescorer import Rescorer, RescorerProvider
+from oryx_tpu.app.als.serving_manager import ALSServingModelManager
+from oryx_tpu.app.als.serving_model import ALSServingModel
+from oryx_tpu.cluster.membership import (Heartbeat, KEY_HEARTBEAT,
+                                         MembershipRegistry,
+                                         without_heartbeats)
+from oryx_tpu.cluster.merge import (canon_sort, exact_local_top_n,
+                                    merge_top_n)
+from oryx_tpu.cluster.sharding import (is_local_item, parse_shard_spec,
+                                       shard_of)
+from oryx_tpu.common.config import from_dict
+from oryx_tpu.kafka.api import KEY_UP, KeyMessage
+
+FEATURES = 4
+
+
+# -- sharding ----------------------------------------------------------------
+
+def test_parse_shard_spec():
+    assert parse_shard_spec("0/1") == (0, 1)
+    assert parse_shard_spec("3/4") == (3, 4)
+    for bad in ("4/4", "-1/2", "x/2", "1", "1/0", "2/1"):
+        with pytest.raises(ValueError):
+            parse_shard_spec(bad)
+
+
+def test_shard_of_is_stable_and_covers_all_shards():
+    ids = [f"i{j}" for j in range(500)]
+    n = 4
+    first = {i: shard_of(i, n) for i in ids}
+    assert all(0 <= s < n for s in first.values())
+    assert {shard_of(i, n) for i in ids} == set(range(n))  # no empty shard
+    assert all(shard_of(i, n) == first[i] for i in ids)    # stable
+    assert all(shard_of(i, 1) == 0 for i in ids[:10])
+    # partition of the catalog: each id local to exactly one shard
+    for i in ids[:50]:
+        assert sum(is_local_item(i, s, n) for s in range(n)) == 1
+
+
+# -- membership --------------------------------------------------------------
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _hb(replica, shard, of=2, gen=1, ready=True, url=None):
+    return Heartbeat(replica=replica, shard=shard, of=of,
+                     url=url or f"http://h:{shard}", generation=gen,
+                     ready=ready)
+
+
+def test_registry_liveness_ttl_and_ready_gating():
+    clock = _Clock()
+    reg = MembershipRegistry(ttl_sec=1.0, clock=clock)
+    reg.note(_hb("a", 0))
+    reg.note(_hb("b", 1))
+    reg.note(_hb("c", 1, ready=False))  # still loading: never routed
+    assert [h.replica for h in reg.candidates(0)] == ["a"]
+    assert [h.replica for h in reg.candidates(1)] == ["b"]
+    assert reg.covered_shards() == [0, 1]
+    clock.t = 2.0  # both age out
+    assert reg.candidates(0) == []
+    assert reg.covered_shards() == []
+    reg.note(_hb("a", 0))  # rejoin: routed again, no reset needed
+    assert [h.replica for h in reg.candidates(0)] == ["a"]
+
+
+def test_registry_prefers_newest_generation_within_shard():
+    reg = MembershipRegistry(ttl_sec=10.0, clock=_Clock())
+    reg.note(_hb("old", 0, gen=1))
+    reg.note(_hb("new", 0, gen=2))
+    # the replica serving the older model is ranked strictly behind
+    for _ in range(4):
+        assert reg.candidates(0)[0].replica == "new"
+        assert reg.candidates(0)[-1].replica == "old"
+
+
+def test_registry_merges_one_topology_only():
+    """A 0/1 full replica must never be merged with 2-way shards: the
+    catalogs overlap and the merge would duplicate items."""
+    reg = MembershipRegistry(ttl_sec=10.0, clock=_Clock())
+    reg.note(_hb("full", 0, of=1))
+    reg.note(_hb("s0", 0, of=2))
+    reg.note(_hb("s1", 1, of=2))
+    assert reg.shard_count == 2
+    assert [h.replica for h in reg.candidates(0)] == ["s0"]
+    assert reg.covered_shards() == [0, 1]
+    assert all(h.of == 2 for h in reg.any_candidates())
+
+
+def test_any_candidates_generation_first_with_rotation():
+    """Rotation must spread load WITHIN the newest generation only — a
+    stale-generation replica is never ranked ahead of an up-to-date
+    one (it would serve stale user-store answers while fresh replicas
+    are live)."""
+    reg = MembershipRegistry(ttl_sec=10.0, clock=_Clock())
+    reg.note(_hb("a", 0, gen=2))
+    reg.note(_hb("b", 1, gen=2))
+    reg.note(_hb("stale", 1, gen=1))
+    seen_first = set()
+    for _ in range(6):
+        c = reg.any_candidates()
+        assert [h.replica for h in c][-1] == "stale"
+        seen_first.add(c[0].replica)
+    assert seen_first == {"a", "b"}  # rotation still spreads load
+
+
+def test_snapshot_reports_current_topology_after_reshard_down():
+    """/metrics must agree with routing: after a reshard down, the
+    live topology (largest of among live replicas), not the largest
+    ever seen."""
+    clock = _Clock()
+    reg = MembershipRegistry(ttl_sec=1.0, clock=clock)
+    for s in range(4):
+        reg.note(_hb(f"r{s}", s, of=4))
+    assert reg.snapshot()["shards"] == 4
+    clock.t = 2.0  # 4-way fleet stops; 2-way fleet starts
+    reg.note(_hb("n0", 0, of=2))
+    reg.note(_hb("n1", 1, of=2))
+    assert reg.shard_count == 2
+    assert reg.snapshot()["shards"] == 2
+
+
+def test_collect_rows_marks_skewed_404_shard_partial():
+    """A shard answering 404 while others return rows (replay skew: one
+    replica absorbed a new user before its peer) must surface as a
+    partial answer, not as a silently incomplete 200; a consensus 404
+    stays a real 404."""
+    from oryx_tpu.cluster.router import _collect_rows
+    from oryx_tpu.cluster.scatter import ShardResponse
+
+    ok = ShardResponse(0, 200, {"rows": [["a", 1.0, 0]]}, "u0")
+    nf = ShardResponse(1, 404, None, "u1")
+    rows, miss, odd = _collect_rows({0: ok, 1: nf})
+    assert rows == [[("a", 1.0, 0)]] and miss == 0 and odd == [1]
+    rows, miss, odd = _collect_rows(
+        {0: ShardResponse(0, 404, None, "u0"), 1: nf})
+    assert rows == [] and miss == 404 and odd == []
+
+
+def test_heartbeat_json_roundtrip_and_malformed_ignored():
+    hb = _hb("r1", 1, gen=7)
+    back = Heartbeat.from_json(hb.to_json())
+    assert back == hb
+    assert Heartbeat.from_json("{not json") is None
+    assert Heartbeat.from_json('{"replica": "x"}') is None
+    reg = MembershipRegistry(ttl_sec=1.0, clock=_Clock())
+    reg.note_message("garbage")  # must not raise
+    assert reg.snapshot()["replicas"] == {}
+
+
+def test_without_heartbeats_filters_only_hb_keys():
+    stream = [KeyMessage(KEY_HEARTBEAT, "{}"), KeyMessage("UP", "u"),
+              KeyMessage("MODEL", "m"), KeyMessage(KEY_HEARTBEAT, "{}")]
+    assert [km.key for km in without_heartbeats(stream)] == ["UP", "MODEL"]
+
+
+def test_manager_ignores_heartbeat_key():
+    mgr = _manager("0/1")
+    mgr.consume_key_message(KEY_HEARTBEAT, '{"whatever": 1}')  # no raise
+    with pytest.raises(ValueError):
+        mgr.consume_key_message("BOGUS", "x")
+
+
+# -- the merge property tests ------------------------------------------------
+
+def _manager(shard_spec: str, rescorer_provider=None) -> ALSServingModelManager:
+    cfg = from_dict({
+        "oryx.serving.model-manager-class": "unused",
+        "oryx.cluster.enabled": True,
+        "oryx.cluster.shard": shard_spec,
+        "oryx.input-topic.broker": None,
+        "oryx.update-topic.broker": None,
+    })
+    mgr = ALSServingModelManager(cfg)
+    mgr.model = ALSServingModel(FEATURES, implicit=True, sample_rate=1.0,
+                                rescorer_provider=rescorer_provider)
+    return mgr
+
+
+def _grid_vec(rng) -> list[float]:
+    """Vectors on a coarse grid: all dot products exact in f32."""
+    return [float(x) / 4.0 for x in rng.integers(-8, 9, FEATURES)]
+
+
+def _feed(managers, key, message):
+    for m in managers:
+        m.consume_key_message(key, message)
+
+
+def _random_replay(rng, managers, n_items=60, n_users=8,
+                   distinct_vectors=14, retire_fraction=0.4):
+    """One simulated update-topic replay, identically consumed by every
+    manager: Y vectors drawn from a small pool (real exact ties), a
+    retire wave (random subset removed — frees store rows), then a
+    second wave whose new/re-added items RECYCLE freed rows in
+    process-specific order."""
+    pool = [_grid_vec(rng) for _ in range(distinct_vectors)]
+    item_ids = [f"i{j}" for j in range(n_items)]
+    for iid in item_ids:
+        vec = pool[int(rng.integers(0, len(pool)))]
+        _feed(managers, KEY_UP, json.dumps(["Y", iid, vec]))
+    for u in range(n_users):
+        known = [item_ids[k] for k in
+                 rng.choice(n_items, size=5, replace=False)]
+        _feed(managers, KEY_UP,
+              json.dumps(["X", f"u{u}", _grid_vec(rng), known]))
+    # retire wave: same ids everywhere; each process frees only the
+    # rows it holds, so free-list order diverges between processes
+    retired = [i for i in item_ids if rng.random() < retire_fraction]
+    for m in managers:
+        for iid in retired:
+            m.model.Y.remove(iid)
+    # second wave: new items + re-added retired items reuse freed rows
+    second = [f"j{j}" for j in range(n_items // 2)] + retired[::2]
+    for iid in second:
+        vec = pool[int(rng.integers(0, len(pool)))]
+        _feed(managers, KEY_UP, json.dumps(["Y", iid, vec]))
+    return item_ids + [f"j{j}" for j in range(n_items // 2)], retired
+
+
+def _oracle_top_n(model, ordinals, how_many, user_vector, exclude=(),
+                  rescore=None, lowest=False):
+    """Independent brute-force reference: numpy dots over the host
+    arrays, sorted by the canonical (score, ordinal, id) order."""
+    host, active, row_ids = model.Y.host_arrays()
+    q = np.asarray(user_vector, np.float32)
+    rows = []
+    for r, iid in enumerate(row_ids):
+        if iid is None or not active[r] or iid in exclude:
+            continue
+        s = float(np.dot(host[r].astype(np.float32), q))
+        if rescore is not None:
+            s = rescore(iid, s)
+            if s is None:
+                continue
+        rows.append((iid, s, ordinals.get(iid, 1 << 62)))
+    return canon_sort(rows, lowest)[:how_many]
+
+
+@pytest.mark.parametrize("shards", [1, 2, 3, 5])
+def test_merged_top_n_is_byte_identical_to_single_node(shards):
+    rng = np.random.default_rng(100 + shards)
+    shard_mgrs = [_manager(f"{s}/{shards}") for s in range(shards)]
+    full = _manager("0/1")
+    managers = shard_mgrs + [full]
+    _random_replay(rng, managers)
+    ordinals = full.item_ordinals
+    assert all(m.item_ordinals == ordinals for m in shard_mgrs)
+    # the shards partition the surviving catalog
+    all_local = sorted(i for m in shard_mgrs
+                       for i in m.model.all_item_ids())
+    assert all_local == sorted(full.model.all_item_ids())
+
+    for u in range(8):
+        uid = f"u{u}"
+        xu = full.model.get_user_vector(uid)
+        exclude = full.model.get_known_items(uid)
+        for how_many in (1, 3, 10, 25):
+            per_shard = [
+                exact_local_top_n(m.model, lambda i, m=m:
+                                  m.item_ordinals.get(i, 1 << 62),
+                                  how_many, user_vector=xu,
+                                  exclude=exclude)
+                for m in shard_mgrs]
+            merged = merge_top_n(per_shard, how_many)
+            single = exact_local_top_n(
+                full.model, lambda i: ordinals.get(i, 1 << 62),
+                how_many, user_vector=xu, exclude=exclude)
+            # byte-identical: ids, order, scores, ordinals
+            assert merged == single[:how_many], (uid, how_many)
+            oracle = _oracle_top_n(full.model, ordinals, how_many, xu,
+                                   exclude)
+            assert merged == oracle, (uid, how_many)
+
+
+def test_boundary_tie_group_straddling_k_is_widened_exactly():
+    """A tie group crossing the local k boundary (where device top-k
+    picks by row order) must be resolved by the widening loop, not by
+    whichever rows the kernel happened to keep."""
+    rng = np.random.default_rng(7)
+    shard_mgrs = [_manager(f"{s}/2") for s in range(2)]
+    full = _manager("0/1")
+    managers = shard_mgrs + [full]
+    # 1 clear winner + 30 items EXACTLY tied + 10 clear losers
+    win = [2.0] * FEATURES
+    tie = [1.0] * FEATURES
+    lose = [0.25] * FEATURES
+    _feed(managers, KEY_UP, json.dumps(["Y", "top", win]))
+    for j in range(30):
+        _feed(managers, KEY_UP, json.dumps(["Y", f"t{j:02d}", tie]))
+    for j in range(10):
+        _feed(managers, KEY_UP, json.dumps(["Y", f"z{j}", lose]))
+    _feed(managers, KEY_UP,
+          json.dumps(["X", "u0", [1.0] * FEATURES, []]))
+    del rng
+    ordinals = full.item_ordinals
+    xu = full.model.get_user_vector("u0")
+    for how_many in (2, 5, 17, 30, 31, 41):
+        per_shard = [exact_local_top_n(
+            m.model, lambda i, m=m: m.item_ordinals.get(i, 1 << 62),
+            how_many, user_vector=xu) for m in shard_mgrs]
+        merged = merge_top_n(per_shard, how_many)
+        oracle = _oracle_top_n(full.model, ordinals, how_many, xu)
+        assert merged == oracle, how_many
+    # ordinal order inside the tie group: first-appearance order
+    ids = [i for i, _, _ in merge_top_n(per_shard, 11)]
+    assert ids == ["top"] + [f"t{j:02d}" for j in range(10)]
+
+
+class _StubStore:
+    def __init__(self, capacity):
+        self._capacity = capacity
+
+    def row_ids(self):
+        return [None] * self._capacity
+
+
+class _StubModel:
+    """Minimal model for exact_local_top_n's widening loop: ``rows``
+    lists (id, score) in DEVICE ROW order; top_n is stable within a
+    score tie, exactly the device kernel's row-index tie-break."""
+
+    def __init__(self, rows, capacity):
+        self.rows = rows
+        self.Y = _StubStore(capacity)
+
+    def item_count(self):
+        return len(self.rows)
+
+    def top_n(self, how_many, user_vector=None, cosine_to=None,
+              exclude=(), rescorer=None, allowed=None, lowest=False,
+              use_lsh=True):
+        cand = [(i, s) for i, s in self.rows if i not in exclude]
+        cand.sort(key=lambda t: t[1] if lowest else -t[1])
+        return cand[:how_many]
+
+
+def test_remote_heavy_exclude_does_not_stop_widening():
+    """On a sharded replica the exclude set is the user's GLOBAL known
+    items — most occupy no local row.  Counting them toward window
+    coverage used to stop the widening loop with live tied candidates
+    still unfetched, so a boundary tie group resolved by device row
+    order instead of the canonical ordinal."""
+    # 50 exactly-tied items whose DEVICE row order is the reverse of
+    # their ordinal order (recycled rows), padded store capacity 64
+    rows = [(f"r{k:02d}", 1.0) for k in range(49, -1, -1)]
+    model = _StubModel(rows, capacity=64)
+    # 100 excluded ids, none of them local to this shard
+    exclude = {f"remote{j}" for j in range(100)}
+    got = exact_local_top_n(model, lambda i: int(i[1:]), 5,
+                            user_vector=[1.0], exclude=exclude)
+    # canonical: the 5 lowest ordinals of the tie group, NOT the 5
+    # highest-row survivors the first narrow fetch happened to see
+    assert got == [(f"r{k:02d}", 1.0, k) for k in range(5)]
+
+
+class _TestRescorer(Rescorer):
+    def rescore(self, item_id, score):
+        # exact arithmetic (halving), order-scrambling (sign flip for
+        # even-suffixed ids), plus filtering
+        return -score / 2.0 if int(item_id[1:]) % 2 == 0 else score
+
+    def is_filtered(self, item_id):
+        return item_id.endswith("3")
+
+
+class _TestProvider(RescorerProvider):
+    def get_recommend_rescorer(self, user_id, args):
+        return _TestRescorer()
+
+    def get_recommend_to_anonymous_rescorer(self, item_ids, args):
+        return _TestRescorer()
+
+    def get_most_popular_items_rescorer(self, args):
+        return None
+
+    def get_most_active_users_rescorer(self, args):
+        return None
+
+    def get_most_similar_items_rescorer(self, args):
+        return None
+
+
+@pytest.mark.parametrize("shards", [2, 3])
+def test_merged_top_n_rescorer_path_matches_single_node(shards):
+    provider = _TestProvider()
+    rng = np.random.default_rng(40 + shards)
+    shard_mgrs = [_manager(f"{s}/{shards}", provider)
+                  for s in range(shards)]
+    full = _manager("0/1", provider)
+    managers = shard_mgrs + [full]
+    _random_replay(rng, managers, n_items=40, retire_fraction=0.3)
+    ordinals = full.item_ordinals
+
+    def rescore(iid, s):
+        r = _TestRescorer()
+        if r.is_filtered(iid):
+            return None
+        return r.rescore(iid, s)
+
+    for u in range(4):
+        uid = f"u{u}"
+        xu = full.model.get_user_vector(uid)
+        exclude = full.model.get_known_items(uid)
+        for how_many in (3, 12):
+            per_shard = [exact_local_top_n(
+                m.model, lambda i, m=m: m.item_ordinals.get(i, 1 << 62),
+                how_many, user_vector=xu, exclude=exclude,
+                rescorer=provider.get_recommend_rescorer(uid, []))
+                for m in shard_mgrs]
+            merged = merge_top_n(per_shard, how_many)
+            single = exact_local_top_n(
+                full.model, lambda i: ordinals.get(i, 1 << 62),
+                how_many, user_vector=xu, exclude=exclude,
+                rescorer=provider.get_recommend_rescorer(uid, []))
+            assert merged == single[:how_many], (uid, how_many)
+            oracle = _oracle_top_n(full.model, ordinals, how_many, xu,
+                                   exclude, rescore=rescore)
+            assert merged == oracle, (uid, how_many)
+
+
+def test_merge_offset_and_lowest():
+    rows_a = [("a", 3.0, 0), ("b", 1.0, 1)]
+    rows_b = [("c", 2.0, 2), ("d", 1.0, 0)]
+    assert [r[0] for r in merge_top_n([rows_a, rows_b], 4)] == \
+        ["a", "c", "d", "b"]  # tie at 1.0: ordinal 0 before 1
+    assert [r[0] for r in merge_top_n([rows_a, rows_b], 2, offset=1)] == \
+        ["c", "d"]
+    assert [r[0] for r in merge_top_n([rows_a, rows_b], 2,
+                                      lowest=True)] == ["d", "b"]
+
+
+def test_sharded_manager_skips_remote_items_but_keeps_ordinals():
+    mgr = _manager("0/2")
+    n = 30
+    for j in range(n):
+        mgr.consume_key_message(
+            KEY_UP, json.dumps(["Y", f"i{j}", [1.0] * FEATURES]))
+    local = [f"i{j}" for j in range(n) if shard_of(f"i{j}", 2) == 0]
+    assert sorted(mgr.model.all_item_ids()) == sorted(local)
+    assert mgr.skipped_remote_items == n - len(local)
+    # ordinals cover EVERY id, local or not, in stream order
+    assert [i for i, _ in sorted(mgr.item_ordinals.items(),
+                                 key=lambda kv: kv[1])] == \
+        [f"i{j}" for j in range(n)]
+
+
+def test_cli_serving_shard_spec_fails_fast():
+    from oryx_tpu.deploy.main import main as cli_main
+    with pytest.raises(ValueError):
+        cli_main(["serving", "--shard", "9/2", "--conf", "/dev/null"])

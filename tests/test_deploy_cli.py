@@ -115,7 +115,11 @@ def test_cli_config_to_properties(tmp_path, capsys):
     conf.write_text('oryx.id = "props-test"\n')
     assert main(["config-to-properties", "--conf", str(conf)]) == 0
     out = capsys.readouterr().out.strip().splitlines()
-    assert out == sorted(out)
+    # sorted by KEY (the reference's TreeMap order): a key that is a
+    # strict prefix of another sorts first even when the '=' separator
+    # would collate after the longer key's next char ('-' < '=')
+    keys = [line.split("=", 1)[0] for line in out]
+    assert keys == sorted(keys)
     assert all("=" in line and line.startswith("oryx") for line in out)
     kv = dict(line.split("=", 1) for line in out)
     assert kv["oryx.id"] == "props-test"

@@ -175,7 +175,9 @@ def test_per_partition_offsets_resume(broker):
     first = [km.message for km in broker.consume(
         "p", group="g", from_beginning=True, max_idle_sec=0.2, stop=stop)]
     assert sorted(first) == sorted(f"m{i}" for i in range(40))
-    assert broker.get_offsets("g", "p") == ends
+    # a partition the keys never hashed to has nothing to commit
+    # (murmur2 keyed placement need not cover every partition)
+    assert [o or 0 for o in broker.get_offsets("g", "p")] == ends
     # new records land after the committed offsets; resume sees only them
     broker.send("p", "k0", "late0")
     broker.send("p", "k5", "late1")

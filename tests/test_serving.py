@@ -332,12 +332,17 @@ def test_console_page(server):
 
 
 def _self_signed_pem(tmp_path):
-    """PEM cert+key via the cryptography package (test fixture only)."""
+    """PEM cert+key via the cryptography package (test fixture only;
+    skip cleanly where the package is absent, as test_http2's TLS
+    fixture already does)."""
     import datetime
-    from cryptography import x509
-    from cryptography.hazmat.primitives import hashes, serialization
-    from cryptography.hazmat.primitives.asymmetric import rsa
-    from cryptography.x509.oid import NameOID
+    try:
+        from cryptography import x509
+        from cryptography.hazmat.primitives import hashes, serialization
+        from cryptography.hazmat.primitives.asymmetric import rsa
+        from cryptography.x509.oid import NameOID
+    except ImportError:
+        pytest.skip("cryptography unavailable")
 
     key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
     name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, "localhost")])
