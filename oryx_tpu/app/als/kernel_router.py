@@ -21,7 +21,10 @@ difference isolates device execution from the transport), then:
     where LSH wins).
 
 The decision and every measured cost are exposed on ``/metrics`` via
-``ALSServingModel.metrics()["kernel_route"]``.
+``ALSServingModel.metrics()["kernel_route"]``, and the chosen variant
+rides every sampled device-execute trace span as the ``kernel_route``
+attribute (``ALSServingModel.kernel_route_label``, attached by
+serving/batcher.py) so a slow trace names the kernel that served it.
 
 Fault points ``route-measure-lsh`` / ``route-measure-exact`` fire
 inside the timed region of the corresponding variant, so a chaos test

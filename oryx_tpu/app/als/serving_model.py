@@ -1054,6 +1054,22 @@ class ALSServingModel(FactorModelBase, ServingModel):
             out["kernel_route"] = r
         return out
 
+    @property
+    def kernel_route_label(self) -> str | None:
+        """Compact label of the measured-cost route serving this shape
+        (kernel_router.measure_routes' ``chosen`` kind, ``+lsh`` when
+        the Hamming-ball mask is honored) — attached to every sampled
+        device-execute span by the scoring batcher so a slow trace
+        names the phase-A variant that ran.  None before routing has
+        measured (or on paths routing cannot time)."""
+        r = self._route
+        if not r:
+            return None
+        chosen = r.get("chosen")
+        if chosen is None:
+            return None
+        return f"{chosen}+lsh" if r.get("use_lsh") else str(chosen)
+
     def _lsh_active(self) -> bool:
         """True when this model's LSH configuration actually prunes
         (hashes exist and the Hamming ball is a strict subset).  Always

@@ -164,7 +164,8 @@ def run_cell(replicas: int, items: int, features: int, users: int,
              broker_dir: str | None = None,
              user_ids: list[str] | None = None,
              device_ms_per_mrow: float = 0.0,
-             spot_users: int = 20) -> dict:
+             spot_users: int = 20,
+             tracing_sample: float | None = None) -> dict:
     publish_s = 0.0
     if broker_dir is None:
         broker_dir = os.path.join(work_dir, f"broker-{replicas}")
@@ -180,11 +181,21 @@ def run_cell(replicas: int, items: int, features: int, users: int,
     # per-replica catalog slice: what the emulated device streams
     slice_rows = items / replicas
     try:
+        # tracing enabled on every process when requested: the
+        # overhead cell runs with a sample ratio low enough that the
+        # measured delta is the UNsampled per-request branch cost
+        obs_extra = {}
+        if tracing_sample is not None:
+            obs_extra = {
+                "oryx.obs.tracing.enabled": True,
+                "oryx.obs.tracing.sample-ratio": tracing_sample,
+            }
         for s in range(replicas):
             conf = os.path.join(work_dir, f"replica-{replicas}-{s}.conf")
             extra = {
                 "oryx.cluster.enabled": True,
                 "oryx.cluster.shard": f"{s}/{replicas}",
+                **obs_extra,
             }
             if device_ms_per_mrow > 0:
                 # fixed-rate accelerator emulation: each scoring
@@ -225,7 +236,7 @@ def run_cell(replicas: int, items: int, features: int, users: int,
                                  f"{s}/{replicas}"], conf,
                                 replica_threads, log_path))
         conf = os.path.join(work_dir, f"router-{replicas}.conf")
-        _write_conf(conf, broker_dir, router_port, {})
+        _write_conf(conf, broker_dir, router_port, dict(obs_extra))
         procs.append(_spawn(["router"], conf, None, log_path))
 
         def _loaded(port: int) -> bool:
@@ -286,6 +297,12 @@ def run_cell(replicas: int, items: int, features: int, users: int,
                 best = out
             else:
                 break
+        if best and best.get("worst_sampled"):
+            # worst sampled requests of the best rung: each trace id
+            # names a recorded span tree on the router's /admin/traces
+            print("worst-p99 sampled requests: " + ", ".join(
+                f"{w['ms']}ms trace={w['trace']}"
+                for w in best["worst_sampled"]), file=sys.stderr)
         partials = _get_json(router_port, "/metrics")["counters"].get(
             "partial_answers", 0)
         return {
@@ -294,6 +311,7 @@ def run_cell(replicas: int, items: int, features: int, users: int,
             "features": features,
             "users": users,
             "replica_threads": replica_threads,
+            "tracing_sample": tracing_sample,
             "emulated_device_ms_per_mrow": device_ms_per_mrow,
             "emulated_dispatch_delay_ms":
                 round(device_ms_per_mrow * slice_rows / 1e6, 3),
@@ -351,6 +369,12 @@ def main(argv: list[str] | None = None) -> int:
                          "slice (no host CPU burned).  0 = off (scan "
                          "cost is the host CPU itself — only "
                          "meaningful when cores >> replicas)")
+    ap.add_argument("--tracing-sample", type=float, default=None,
+                    help="enable oryx.obs tracing on every process at "
+                         "this sample ratio (e.g. 0.001 measures the "
+                         "UNsampled per-request overhead, 1.0 records "
+                         "every request).  Default: tracing off — the "
+                         "shipped configuration")
     ap.add_argument("--out", default="BENCH_GATEWAY_r07.json")
     ap.add_argument("--keep-work", action="store_true")
     args = ap.parse_args(argv)
@@ -383,7 +407,8 @@ def main(argv: list[str] | None = None) -> int:
                 n, args.items, args.features, args.users, rates,
                 args.duration, args.replica_threads, work_dir,
                 broker_dir=broker_dir, user_ids=user_ids,
-                device_ms_per_mrow=args.device_ms_per_mrow)
+                device_ms_per_mrow=args.device_ms_per_mrow,
+                tracing_sample=args.tracing_sample)
             row["publish_s"] = publish_s
             rows.append(row)
             print(json.dumps({k: v for k, v in rows[-1].items()
@@ -395,6 +420,7 @@ def main(argv: list[str] | None = None) -> int:
     by_n = {r["replicas"]: r["open_loop_sustained_qps"] for r in rows}
     report = {
         "metric": "gateway_recommend_scaling",
+        "tracing_sample": args.tracing_sample,
         "emulated_device_ms_per_mrow": args.device_ms_per_mrow,
         "backend": "cpu" if os.environ.get(
             "JAX_PLATFORMS", "cpu") == "cpu" else "tpu",

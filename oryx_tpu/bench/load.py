@@ -234,6 +234,9 @@ def run_recommend_open_loop(base_url: str, user_ids: list[str],
     latencies: list[float] = []
     lateness: list[float] = []
     done_ts: list[float] = []
+    # (latency_ms, X-Oryx-Trace id) for sampled responses: lets the
+    # harness name the recorded trace behind each worst-p99 request
+    traced: list[tuple[float, str]] = []
     errors = [0]
     lock = threading.Lock()
     next_index = [0]
@@ -251,7 +254,7 @@ def run_recommend_open_loop(base_url: str, user_ids: list[str],
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             rfile = conn.makefile("rb")
 
-        def one(path: str) -> bool:
+        def one(path: str) -> tuple[bool, str | None]:
             conn.sendall(f"GET {path} HTTP/1.1\r\nHost: a\r\n\r\n"
                          .encode("latin-1"))
             status_line = rfile.readline(65537)
@@ -259,12 +262,15 @@ def run_recommend_open_loop(base_url: str, user_ids: list[str],
                 raise ConnectionError("closed")
             status = int(status_line.split(b" ", 2)[1])
             clen = 0
+            trace = None
             while True:
                 h = rfile.readline(65537)
                 if h in (b"\r\n", b"\n", b""):
                     break
                 if h[:15].lower() == b"content-length:":
                     clen = int(h[15:])
+                elif h[:13].lower() == b"x-oryx-trace:":
+                    trace = h[13:].strip().decode("latin-1")
             if clen:
                 remaining = clen
                 while remaining:
@@ -272,7 +278,7 @@ def run_recommend_open_loop(base_url: str, user_ids: list[str],
                     if not got:
                         raise ConnectionError("short body")
                     remaining -= len(got)
-            return status == 200
+            return status == 200, trace
 
         try:
             while True:
@@ -288,10 +294,11 @@ def run_recommend_open_loop(base_url: str, user_ids: list[str],
                 late = max(0.0, time.perf_counter() - scheduled)
                 path = (f"{path_prefix}/recommend/{user_ids[picks[i]]}"
                         f"?howMany={how_many}")
+                trace = None
                 try:
                     if conn is None:
                         connect()
-                    ok = one(path)
+                    ok, trace = one(path)
                 except Exception:  # noqa: BLE001 — counted as error
                     ok = False
                     if conn is not None:
@@ -307,6 +314,8 @@ def run_recommend_open_loop(base_url: str, user_ids: list[str],
                     if ok:
                         latencies.append(ms)
                         done_ts.append(done - t0)
+                        if trace:
+                            traced.append((ms, trace))
                     else:
                         errors[0] += 1
         finally:
@@ -362,10 +371,16 @@ def run_recommend_open_loop(base_url: str, user_ids: list[str],
         q3 = float(np.mean(late[n_l // 2:3 * n_l // 4]))
         q4 = float(np.mean(late[3 * n_l // 4:]))
         growing = q4 > q3 + 200.0  # ms of drift across ~1/4 of the run
+    # worst sampled requests, slowest first: each X-Oryx-Trace id names
+    # a recorded span tree on /admin/traces, so a bad p99 here is
+    # directly attributable (queue-wait vs device-execute vs merge)
+    worst = [{"ms": round(ms, 1), "trace": t}
+             for ms, t in sorted(traced, reverse=True)[:5]]
     return {
         "offered_qps": round(rate_qps, 1),
         "achieved_qps": round(achieved, 1),
         "errors": errors[0],
+        "worst_sampled": worst,
         "p50_ms": round(float(np.percentile(lat, 50)), 1) if len(lat) else None,
         "p95_ms": round(float(np.percentile(lat, 95)), 1) if len(lat) else None,
         # mean time requests spent waiting for a free client slot past

@@ -40,8 +40,13 @@ from ..api.serving import OryxServingException
 from ..common.config import Config
 from ..kafka import utils as kafka_utils
 from ..kafka.inproc import InProcTopicProducer, resolve_broker
-from ..lambda_rt.http import HttpApp, Request, Route, make_server
+from ..lambda_rt.http import HttpApp, Request, Route, TextResponse, \
+    make_server
 from ..lambda_rt.metrics import MetricsRegistry
+from ..obs import (merge_snapshots, render_prometheus_blocks,
+                   tracer_from_config)
+from ..obs.server import (admin_profile, admin_traces,
+                          own_prometheus_snapshot)
 from ..ops import als_fold_in
 from ..ops.solver import SingularMatrixSolverException, get_solver
 from ..resilience import faults
@@ -258,7 +263,16 @@ def _fold_user_vector(req: Request, item_values: list[tuple[str, float]],
 def _merged_response(req: Request, rows: list[list[Row]],
                      failed: Sequence[int], how_many: int, offset: int,
                      lowest: bool = False):
-    merged = merge_top_n(rows, how_many, offset, lowest=lowest)
+    tracer = req.context.get("tracer")
+    if tracer is None:
+        merged = merge_top_n(rows, how_many, offset, lowest=lowest)
+    else:
+        # the gather-side counterpart of the scatter's shard_call
+        # spans: how long the exact cross-shard merge itself took
+        with tracer.span("router.merge") as span:
+            span.set_attr("shards_merged", len(rows))
+            span.set_attr("rows_in", sum(len(r) for r in rows))
+            merged = merge_top_n(rows, how_many, offset, lowest=lowest)
     return 200, _id_values(merged), _partial_headers(req, failed)
 
 
@@ -572,9 +586,36 @@ def _ready(req: Request):
     return None
 
 
+def _prometheus_metrics(req: Request, registry: MetricsRegistry,
+                        fmt: str):
+    """The router's non-JSON /metrics forms.  ``prometheus-json`` is
+    the router's OWN mergeable snapshot; ``prometheus`` additionally
+    scrapes every live replica's snapshot and renders the cluster-wide
+    merge — fixed-bucket histogram counts sum exactly across replicas
+    (obs/prom.py), which reservoir percentiles never could."""
+    snap = own_prometheus_snapshot(req, registry)
+    if fmt == "prometheus-json":
+        return snap
+    scraped = _sg(req).scrape_replicas(
+        "/metrics?format=prometheus-json", deadline=req.deadline)
+    merged = merge_snapshots([payload for _, payload in scraped])
+    # how many replicas the merged block actually covers: a replica
+    # that failed its scrape is silently absent from the sums, and the
+    # reader must be able to tell a full view from a partial one
+    merged["gauges"] = {"scraped_replicas": len(scraped)}
+    # one exposition for both blocks: the text format allows exactly
+    # one # TYPE line per metric name, so the families are emitted
+    # once with router- and replica-labeled samples grouped together
+    return TextResponse(render_prometheus_blocks(
+        [(snap, {"tier": "router"}), (merged, {"tier": "replica"})]))
+
+
 def _metrics(req: Request):
     registry: MetricsRegistry = req.context["metrics"]
-    return {
+    fmt = req.q1("format", "json")
+    if fmt in ("prometheus", "prometheus-json"):
+        return _prometheus_metrics(req, registry, fmt)
+    out = {
         "routes": registry.snapshot(),
         "counters": registry.counters_snapshot(),
         "cluster": {
@@ -584,6 +625,10 @@ def _metrics(req: Request):
         },
         "resilience": resilience_snapshot(),
     }
+    tracer = req.context.get("tracer")
+    if tracer is not None:
+        out["obs"] = {"trace_record_failures": tracer.record_failures}
+    return out
 
 
 def _error(req: Request):
@@ -620,6 +665,10 @@ ROUTES = [
     Route("POST", "/ingest", _ingest, mutates=True),
     Route("GET", "/ready", _ready),
     Route("GET", "/metrics", _metrics),
+    Route("GET", "/admin/traces", admin_traces),
+    # mutating: captures device state to disk — read-only mode and
+    # DIGEST auth (when configured) both gate it
+    Route("GET", "/admin/profile", admin_profile, mutates=True),
     Route("GET", "/error", _error),
     console.console_route("ALS scatter-gather gateway", [
         console.Endpoint("/recommend/{0}", ("userID",)),
@@ -666,7 +715,13 @@ class RouterLayer:
         faults.configure_from_config(config)
         ttl = config.get_int("oryx.cluster.heartbeat-ttl-ms") / 1000.0
         self.membership = MembershipRegistry(ttl)
-        self.scatter = ScatterGather(self.membership, config)
+        # sampled distributed tracing (obs/trace.py; None = disabled):
+        # the request span opens at the HTTP dispatcher, each shard
+        # query runs under a router.shard_call span whose context rides
+        # the internal hop as the `traceparent` header
+        self.tracer = tracer_from_config(config, "router")
+        self.scatter = ScatterGather(self.membership, config,
+                                     tracer=self.tracer)
         self.metrics = MetricsRegistry()
         self.input_producer = None
         self.input_breaker = CircuitBreaker.from_config(
@@ -690,6 +745,7 @@ class RouterLayer:
                 "membership": self.membership,
                 "scatter": self.scatter,
                 "metrics": self.metrics,
+                "tracer": self.tracer,
                 "config": config,
                 "input_producer": self.input_producer,
                 "yty_cache": {},

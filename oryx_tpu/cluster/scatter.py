@@ -235,8 +235,22 @@ class _DigestAuth:
 
 class ScatterGather:
     def __init__(self, registry: MembershipRegistry, config,
-                 max_concurrency: int = 64):
+                 max_concurrency: int = 64, tracer=None):
         self.registry = registry
+        # obs/trace.py tracer (None = tracing off): each shard query of
+        # a sampled request gets a `router.shard_call` span whose
+        # context rides the internal hop as the `traceparent` header,
+        # so the replica's own request span parents under it
+        self.tracer = tracer
+        # unsampled requests must ALSO propagate context (flags 00):
+        # sampling is decided once at the root, and without the header
+        # a tracing-enabled replica would re-roll its own dice on every
+        # internal hop.  One process-constant string keeps the
+        # unsampled hot path allocation-free.
+        self._unsampled_tp = None
+        if tracer is not None:
+            from ..obs.trace import unsampled_traceparent
+            self._unsampled_tp = unsampled_traceparent()
         c = "oryx.cluster"
         self.hedge_after_sec = config.get_int(f"{c}.hedge-after-ms") / 1000.0
         self.shard_timeout_sec = \
@@ -273,9 +287,12 @@ class ScatterGather:
     # -- one attempt ---------------------------------------------------------
 
     def _attempt(self, hb: Heartbeat, shard: int, method: str, path: str,
-                 body: bytes | None, deadline: Deadline | None):
+                 body: bytes | None, deadline: Deadline | None,
+                 traceparent: str | None = None):
         timeout = self.shard_timeout_sec
         headers = {}
+        if traceparent:
+            headers["Traceparent"] = traceparent
         if deadline is not None:
             remaining = deadline.remaining()
             if remaining <= 0.0:
@@ -354,11 +371,48 @@ class ScatterGather:
 
     def query_shard(self, shard: int, method: str, path: str,
                     body: bytes | None = None,
-                    deadline: Deadline | None = None) -> ShardResponse:
+                    deadline: Deadline | None = None,
+                    parent_span=None) -> ShardResponse:
         """Authoritative response from ``shard``, via hedged attempts
         over its live replicas; :class:`ShardUnavailable` when none
-        answers within the deadline."""
+        answers within the deadline.
+
+        ``parent_span`` is the caller's request span when this call
+        runs on a pool thread (scatter fan-out) where thread-local
+        trace context does not follow; called inline on the handler
+        thread, the tracer's thread-current span is used."""
         faults.fire("router-shard-timeout")
+        span, tp = self._begin_shard_span(shard, parent_span)
+        try:
+            res = self._query_shard(shard, method, path, body, deadline,
+                                    tp)
+        except BaseException:
+            if span is not None:
+                span.end("error")
+            raise
+        if span is not None:
+            span.set_attr("replica", res.replica)
+            span.set_attr("http.status", res.status)
+            span.end()
+        return res
+
+    def _begin_shard_span(self, shard: int, parent_span):
+        """(span, traceparent) for one shard query — (None, None) when
+        tracing is off, (None, flags-00 context) when the root decided
+        not to sample."""
+        if self.tracer is None:
+            return None, None
+        parent = parent_span if parent_span is not None \
+            else self.tracer.current()
+        span = self.tracer.child_span(parent, "router.shard_call")
+        if not span.sampled:
+            return None, self._unsampled_tp
+        span.set_attr("shard", shard)
+        return span, span.traceparent()
+
+    def _query_shard(self, shard: int, method: str, path: str,
+                     body: bytes | None, deadline: Deadline | None,
+                     tp: str | None) -> ShardResponse:
         candidates = self.registry.candidates(shard)
         if not candidates:
             with self._lock:
@@ -369,7 +423,7 @@ class ScatterGather:
             # (per-request thread spawns are measurable at gateway qps)
             try:
                 return self._attempt(candidates[0], shard, method, path,
-                                     body, deadline)
+                                     body, deadline, tp)
             except ShardUnavailable:
                 with self._lock:
                     self.shard_failures += 1
@@ -387,7 +441,7 @@ class ScatterGather:
             def run():
                 try:
                     box.put(self._attempt(hb, shard, method, path, body,
-                                          deadline))
+                                          deadline, tp))
                 except BaseException as e:  # noqa: BLE001 — collected
                     box.put(e)
             threading.Thread(target=run, daemon=True,
@@ -457,11 +511,16 @@ class ScatterGather:
         ShardUnavailable only when EVERY queried shard failed."""
         targets = range(self.registry.shard_count) \
             if shards is None else shards
+        # trace context is captured HERE, on the requesting handler
+        # thread — the per-shard queries run on pool threads where the
+        # tracer's thread-local current span does not follow
+        parent = self.tracer.current() if self.tracer is not None \
+            else None
         futures = {
             s: self._exec.submit(
                 self.query_shard, s,
                 method, paths if isinstance(paths, str) else paths[s],
-                body, deadline)
+                body, deadline, parent)
             for s in targets}
         results: dict[int, ShardResponse] = {}
         failed: list[int] = []
@@ -494,15 +553,55 @@ class ScatterGather:
         candidates = self.registry.any_candidates()
         if not candidates:
             raise ShardUnavailable("no live ready replica")
+        span, tp = self._begin_shard_span(-1, None)
         last: BaseException | None = None
         for hb in candidates[:max(self.max_attempts, 1)]:
             try:
-                return self._attempt(hb, hb.shard, method, path, body,
-                                     deadline)
+                res = self._attempt(hb, hb.shard, method, path, body,
+                                    deadline, tp)
             except (ShardUnavailable, CircuitOpenError,
                     OSError, ConnectionError, ValueError) as e:
                 last = e
+                continue
+            if span is not None:
+                span.set_attr("shard", hb.shard)
+                span.set_attr("replica", res.replica)
+                span.set_attr("http.status", res.status)
+                span.end()
+            return res
+        if span is not None:
+            span.end("error")
         raise ShardUnavailable(f"no replica answered: {last}")
+
+    def scrape_replicas(self, path: str,
+                        deadline: Deadline | None = None
+                        ) -> list[tuple[Heartbeat, dict]]:
+        """Best-effort GET against EVERY live ready replica — not one
+        per shard like ``scatter`` — returning ``(heartbeat, payload)``
+        for each 2xx JSON answer.  The cluster-wide metrics merge needs
+        every replica's histogram buckets; a replica that fails or
+        stalls is simply absent from the merge (the exposition reports
+        how many were scraped)."""
+        candidates = self.registry.any_candidates()
+        if not candidates:
+            return []
+        # scrapes are control plane, never trace roots: mark them
+        # explicitly unsampled so replicas don't sample 1% of them
+        futures = [(hb, self._exec.submit(self._attempt, hb, hb.shard,
+                                          "GET", path, None, deadline,
+                                          self._unsampled_tp))
+                   for hb in candidates]
+        out: list[tuple[Heartbeat, dict]] = []
+        for hb, f in futures:
+            try:
+                r = f.result(timeout=self.shard_timeout_sec + 1.0
+                             if deadline is None
+                             else max(0.05, deadline.remaining()) + 0.25)
+            except Exception:  # noqa: BLE001 — replica drops from merge
+                continue
+            if r.ok and isinstance(r.payload, dict):
+                out.append((hb, r.payload))
+        return out
 
     def stats(self) -> dict:
         with self._lock:

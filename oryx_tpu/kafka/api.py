@@ -21,17 +21,30 @@ KEY_UP = "UP"
 
 
 class KeyMessage(NamedTuple):
-    """A (key, message) pair from a topic."""
+    """A (key, message) pair from a topic, with optional record headers.
+
+    Headers carry out-of-band metadata the message body must not be
+    polluted with — Kafka's record-header contract.  The framework uses
+    exactly two, both attached by the serving front end's input sends
+    (serving/framework.py ``send_input``): ``ts`` (ingest wall-clock
+    epoch ms, feeding the speed layer's ingest→servable freshness
+    gauge) and ``traceparent`` (W3C trace context on sampled requests,
+    so a ``/ingest`` can be followed into the speed layer's fold-in —
+    obs/trace.py).  Strictly best-effort: consumers must treat headers
+    as absent-by-default (the wire-protocol binding does not propagate
+    them)."""
 
     key: str | None
     message: str
+    headers: dict[str, str] | None = None
 
 
 @runtime_checkable
 class TopicProducer(Protocol):
     """Wraps access to a message topic to write to."""
 
-    def send(self, key: str | None, message: str) -> None: ...
+    def send(self, key: str | None, message: str,
+             headers: dict[str, str] | None = None) -> None: ...
 
     def get_update_broker(self) -> str: ...
 

@@ -145,7 +145,13 @@ class KafkaBroker:
 
     # -- produce / consume ----------------------------------------------
 
-    def send(self, topic: str, key: str | None, message: str) -> int:
+    def send(self, topic: str, key: str | None, message: str,
+             headers: dict | None = None) -> int:
+        # record headers are accepted for API parity with the in-proc
+        # broker but not propagated: the wire binding's v2 RecordBatch
+        # codec writes headers-count 0 (kafka/api.py documents headers
+        # as strictly best-effort / absent-by-default)
+        del headers
         parts = self._partitions(topic)
         if key is not None:
             p = parts[partition_for_key(key, len(parts))]
@@ -349,8 +355,9 @@ class KafkaTopicProducer(TopicProducer):
         self._topic = topic
         self._broker = get_kafka_broker(broker_uri)
 
-    def send(self, key: str | None, message: str) -> None:
-        self._broker.send(self._topic, key, message)
+    def send(self, key: str | None, message: str,
+             headers: dict | None = None) -> None:
+        self._broker.send(self._topic, key, message, headers)
 
     def get_update_broker(self) -> str:
         return self._broker_uri

@@ -115,7 +115,11 @@ class _Partition:
     new data on any partition."""
 
     def __init__(self, notify, persist_path: str | None):
-        self.log: list[tuple[str | None, str]] = []
+        # (key, message, headers-or-None) triples; headers are optional
+        # record metadata (trace context, ingest timestamps) serialized
+        # as a third JSONL array element only when present, so logs
+        # written by older processes read back unchanged
+        self.log: list[tuple[str | None, str, dict | None]] = []
         self._lock = threading.RLock()
         self._notify = notify
         self.persist_path = persist_path
@@ -149,13 +153,17 @@ class _Partition:
         appended = False
         for raw in lines:
             if raw.strip():
-                k, m = json.loads(raw.decode("utf-8"))
-                self.log.append((k, m))
+                rec = json.loads(raw.decode("utf-8"))
+                self.log.append((rec[0], rec[1],
+                                 rec[2] if len(rec) > 2 else None))
                 appended = True
         return appended
 
-    def append(self, key: str | None, message: str) -> int:
-        record = (json.dumps([key, message]) + "\n").encode("utf-8")
+    def append(self, key: str | None, message: str,
+               headers: dict | None = None) -> int:
+        rec = [key, message] if headers is None else [key, message,
+                                                     headers]
+        record = (json.dumps(rec) + "\n").encode("utf-8")
         with self._lock:
             if self._fd is not None:
                 # the file is the source of truth: write, then re-read
@@ -165,7 +173,7 @@ class _Partition:
                 self._refresh_locked()
                 offset = len(self.log) - 1
             else:
-                self.log.append((key, message))
+                self.log.append((key, message, headers))
                 offset = len(self.log) - 1
         self._notify()
         return offset
@@ -180,7 +188,7 @@ class _Partition:
         with self._lock:
             return len(self.log)
 
-    def get(self, pos: int) -> tuple[str | None, str]:
+    def get(self, pos: int) -> tuple[str | None, str, dict | None]:
         with self._lock:
             return self.log[pos]
 
@@ -194,7 +202,8 @@ class _Partition:
             return []
         with self._lock:
             self._refresh_locked()
-            return [KeyMessage(k, m) for k, m in self.log[start:end]]
+            return [KeyMessage(k, m, h)
+                    for k, m, h in self.log[start:end]]
 
     def close(self) -> None:
         if self._fd is not None:
@@ -374,7 +383,8 @@ class InProcBroker:
 
     # -- produce / consume --------------------------------------------------
 
-    def send(self, topic: str, key: str | None, message: str) -> int:
+    def send(self, topic: str, key: str | None, message: str,
+             headers: dict | None = None) -> int:
         """Append to the key's partition; returns the record's offset
         within that partition."""
         # chaos seam: error (broker down), delay (slow broker), or
@@ -384,9 +394,9 @@ class InProcBroker:
             return -1  # acked but lost: the fault a durable log rules out
         t = self._topic(topic)
         p = t.partitions[t.partition_for(key)]
-        offset = p.append(key, message)
+        offset = p.append(key, message, headers)
         if action == "duplicate":
-            offset = p.append(key, message)
+            offset = p.append(key, message, headers)
         return offset
 
     def latest_offset(self, topic: str) -> int:
@@ -486,7 +496,7 @@ class InProcBroker:
                     t.refresh_all()
                 # round-robin across ready partitions for fairness
                 part = min(ready, key=lambda i: (i - next_part) % n)
-                key, message = t.partitions[part].get(pos[part])
+                key, message, headers = t.partitions[part].get(pos[part])
                 pos[part] += 1
                 next_part = (part + 1) % n
                 idle_since = time.monotonic()
@@ -497,7 +507,7 @@ class InProcBroker:
                 # A consumer that breaks or crashes mid-processing leaves
                 # the in-flight message uncommitted, so a restart
                 # redelivers it — duplicates are possible, loss is not.
-                yield KeyMessage(key, message)
+                yield KeyMessage(key, message, headers)
                 if group is not None:
                     self.set_offset(group, topic, pos[part], part)
                 if stop is not None and stop.is_set():
@@ -616,8 +626,9 @@ class InProcTopicProducer(TopicProducer):
         self._topic = topic
         self._broker = resolve_broker(broker_uri)
 
-    def send(self, key: str | None, message: str) -> None:
-        self._broker.send(self._topic, key, message)
+    def send(self, key: str | None, message: str,
+             headers: dict | None = None) -> None:
+        self._broker.send(self._topic, key, message, headers)
 
     def get_update_broker(self) -> str:
         return self._broker_uri

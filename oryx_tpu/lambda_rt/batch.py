@@ -21,8 +21,11 @@ from ..common.lang import load_instance
 from ..kafka import utils as kafka_utils
 from ..kafka.api import KeyMessage
 from ..kafka.inproc import InProcTopicProducer, resolve_broker
+from ..obs import freshness, tracer_from_config
+from ..obs.server import ObsServer
 from ..resilience import faults
 from . import data_store
+from .metrics import MetricsRegistry
 
 _log = logging.getLogger(__name__)
 
@@ -54,12 +57,32 @@ class BatchLayer:
         self._thread: threading.Thread | None = None
         # config-staged chaos (oryx.resilience.faults.*); empty = no-op
         faults.configure_from_config(config)
+        # freshness surface (obs/freshness.py), read via the side-door
+        # ObsServer — the batch tier serves no public HTTP of its own.
+        # batch_generation_age_sec is the batch cadence seen from the
+        # PRODUCING side (the consuming tiers report their own
+        # model_generation_age_sec from the update-topic replay).
+        self.metrics = MetricsRegistry()
+        self._last_generation_mono: float | None = None
+        self.metrics.gauge_fn(
+            "input_lag_records",
+            freshness.group_lag_fn(self.input_broker, self.input_topic,
+                                   self._group))
+        self.metrics.gauge_fn("batch_generation_age_sec",
+                              self._generation_age_sec)
+        self.obs_server = ObsServer(config, self.metrics,
+                                    tracer_from_config(config, "batch"))
+
+    def _generation_age_sec(self) -> float | None:
+        t = self._last_generation_mono
+        return None if t is None else round(time.monotonic() - t, 3)
 
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> None:
         _log.info("Starting batch layer (generation interval %ds)",
                   self.generation_interval_sec)
+        self.obs_server.start()
         # JVM-parity cold start: reload compiled XLA programs from disk
         # instead of re-paying 100+ s of trainer compilation per restart
         compile_cache.enable_from_config(self.config)
@@ -78,6 +101,7 @@ class BatchLayer:
 
     def close(self) -> None:
         self._stop.set()
+        self.obs_server.close()
         if self._thread:
             self._thread.join(10.0)
 
@@ -124,6 +148,7 @@ class BatchLayer:
         then commit offsets and apply TTLs — commit ordering gives
         at-least-once with idempotent overwrite (reference semantics)."""
         timestamp_ms = int(time.time() * 1000)
+        t_gen = time.monotonic()
         broker = resolve_broker(self.input_broker)
         self._recover_offsets(broker)
         # per-partition offsets (P7 — reference: UpdateOffsetsFn.java:
@@ -165,3 +190,9 @@ class BatchLayer:
 
         data_store.delete_old_data(self.data_dir, self.max_age_data_hours)
         data_store.delete_old_models(self.model_dir, self.max_age_model_hours)
+        # freshness bookkeeping only after the generation fully landed
+        self._last_generation_mono = time.monotonic()
+        self.metrics.set_gauge(
+            "batch_generation_duration_ms",
+            round((self._last_generation_mono - t_gen) * 1000.0, 3))
+        self.metrics.set_gauge("batch_generation_records", len(new_data))

@@ -183,6 +183,11 @@ class HttpApp:
         # single injection point: the dispatcher records into the same
         # registry the /metrics endpoint reads from the context
         self.metrics = context.get("metrics")
+        # request tracing (obs/trace.py): None = disabled, and the
+        # whole apparatus costs one attribute check per request
+        self.tracer = context.get("tracer")
+        self._request_span = (f"{self.tracer.service}.request"
+                              if self.tracer is not None else None)
         self.read_only = read_only
         self.user_name = user_name
         self.password = password
@@ -285,6 +290,19 @@ class HttpApp:
         t0 = time.perf_counter()
         handler._oryx_route = None
         handler._oryx_status = 0
+        # reset per request: handler objects persist across keep-alive
+        # requests, and a stale trace id must not leak onto the next
+        # response's X-Oryx-Trace header
+        handler._oryx_trace = None
+        span = None
+        if self.tracer is not None:
+            # sampled (or inbound-sampled) requests get a request span
+            # and echo X-Oryx-Trace; unsampled requests get the shared
+            # no-op span — one branch, no allocation
+            span = self.tracer.begin_request(
+                self._request_span, handler.headers.get("Traceparent"))
+            if span.sampled:
+                handler._oryx_trace = span.trace_id
         try:
             self._handle(handler)
         except BrokenPipeError:  # client went away
@@ -298,6 +316,10 @@ class HttpApp:
                 self.metrics.record(handler._oryx_route or "unmatched",
                                     handler._oryx_status,
                                     time.perf_counter() - t0)
+            if span is not None and span.sampled:
+                self.tracer.end_request(span,
+                                        status=handler._oryx_status,
+                                        route=handler._oryx_route)
 
     def _handle(self, handler: BaseHTTPRequestHandler) -> None:
         if not self._auth_ok(handler):
@@ -384,10 +406,13 @@ class HttpApp:
         elif isinstance(result, tuple) and len(result) == 2 \
                 and isinstance(result[0], int):
             status, result = result
+        trace_id = getattr(handler, "_oryx_trace", None)
         if result is None:
             status = status if status != 200 else 204
             handler._oryx_status = status
             handler.send_response(status)
+            if trace_id:
+                handler.send_header("X-Oryx-Trace", trace_id)
             for k, v in extra_headers.items():
                 handler.send_header(k, v)
             handler.end_headers()
@@ -395,6 +420,10 @@ class HttpApp:
         handler._oryx_status = status
         payload, ctype = json_or_csv(result, accept)
         handler.send_response(status)
+        if trace_id:
+            # sampled request: hand the trace id back so a slow answer
+            # can be correlated with its recorded trace (/admin/traces)
+            handler.send_header("X-Oryx-Trace", trace_id)
         for k, v in extra_headers.items():
             handler.send_header(k, v)
         handler.send_header("Content-Type", ctype)
@@ -420,6 +449,9 @@ class HttpApp:
         payload, ctype = render_error_page(
             status, None, message, handler.headers.get("Accept", ""))
         handler.send_response(status)
+        trace_id = getattr(handler, "_oryx_trace", None)
+        if trace_id:
+            handler.send_header("X-Oryx-Trace", trace_id)
         handler.send_header("Content-Type", ctype)
         handler.send_header("Content-Length", str(len(payload)))
         handler.end_headers()

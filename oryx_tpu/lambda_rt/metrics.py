@@ -6,13 +6,28 @@ least request counts and latency percentiles per endpoint.  This is a
 minimal thread-safe registry: per-route counters plus a bounded
 latency reservoir (ring buffer), surfaced by the ``/metrics`` endpoint
 (serving/framework.py) and usable from bench harnesses.
+
+Each route also feeds a fixed-bucket latency histogram (obs/prom.py):
+reservoir percentiles are exact per process but cannot be combined,
+while bucket counts merge exactly — the cluster gateway sums them
+across replicas for the ``/metrics?format=prometheus`` cluster view.
+Errors are split by class: ``client_errors`` (4xx — the caller's
+problem) vs ``server_errors`` (5xx, plus status 0 = the connection
+died before a response was written), so a burst of 404s or partial-
+answer-tolerant clients cannot pollute the server fault signal.
+Named gauges (set directly or computed-on-read via ``gauge_fn``) carry
+the lambda freshness surface: consumer lag, model generation age,
+batch cadence.
 """
 
 from __future__ import annotations
 
 import threading
+from typing import Callable
 
 import numpy as np
+
+from ..obs.prom import Histogram
 
 __all__ = ["MetricsRegistry"]
 
@@ -22,34 +37,44 @@ _RESERVOIR = 8192
 
 
 class _RouteStats:
-    __slots__ = ("count", "errors", "total_ms", "latencies", "pos", "filled")
+    __slots__ = ("count", "client_errors", "server_errors", "total_ms",
+                 "latencies", "pos", "filled", "hist")
 
     def __init__(self):
         self.count = 0
-        self.errors = 0
+        self.client_errors = 0
+        self.server_errors = 0
         self.total_ms = 0.0
         self.latencies = np.zeros(_RESERVOIR, dtype=np.float32)
         self.pos = 0
         self.filled = False
+        self.hist = Histogram()
 
     def record(self, status: int, ms: float) -> None:
         self.count += 1
-        # status 0 = connection died before a response was written
-        if status >= 400 or status == 0:
-            self.errors += 1
+        if 400 <= status < 500:
+            self.client_errors += 1
+        elif status >= 500 or status == 0:
+            # status 0 = connection died before a response was written —
+            # indistinguishable from a server fault, counted as one
+            self.server_errors += 1
         self.total_ms += ms
         self.latencies[self.pos] = ms
         self.pos += 1
         if self.pos >= _RESERVOIR:
             self.pos = 0
             self.filled = True
+        self.hist.observe(ms)
 
     def snapshot(self) -> dict:
         window = self.latencies[:self.pos] if not self.filled \
             else self.latencies
         out = {
             "count": self.count,
-            "errors": self.errors,
+            # back-compat total alongside the class split
+            "errors": self.client_errors + self.server_errors,
+            "client_errors": self.client_errors,
+            "server_errors": self.server_errors,
             "mean_ms": round(self.total_ms / self.count, 3)
             if self.count else 0.0,
         }
@@ -60,13 +85,24 @@ class _RouteStats:
                        p99_ms=round(float(p99), 3))
         return out
 
+    def prometheus_snapshot(self) -> dict:
+        return {
+            "count": self.count,
+            "client_errors": self.client_errors,
+            "server_errors": self.server_errors,
+            "latency_ms": self.hist.snapshot(),
+        }
+
 
 class MetricsRegistry:
-    """Thread-safe per-route request stats + named event counters."""
+    """Thread-safe per-route request stats + named event counters and
+    gauges."""
 
     def __init__(self):
         self._routes: dict[str, _RouteStats] = {}
         self._counters: dict[str, int] = {}
+        self._gauges: dict[str, float] = {}
+        self._gauge_fns: dict[str, Callable[[], float | None]] = {}
         self._lock = threading.Lock()
 
     def record(self, route: str, status: int, seconds: float) -> None:
@@ -82,12 +118,51 @@ class MetricsRegistry:
         with self._lock:
             self._counters[counter] = self._counters.get(counter, 0) + by
 
+    def set_gauge(self, gauge: str, value: float) -> None:
+        """Set an instantaneous gauge (the speed layer's freshness
+        measurements land here after each micro-batch)."""
+        with self._lock:
+            self._gauges[gauge] = value
+
+    def gauge_fn(self, gauge: str,
+                 fn: Callable[[], float | None]) -> None:
+        """Register a computed-on-read gauge (consumer lag, model
+        generation age — values that are a subtraction at read time,
+        not an event at write time).  Evaluated best-effort at
+        snapshot; a raising fn reports null rather than failing
+        ``/metrics``."""
+        with self._lock:
+            self._gauge_fns[gauge] = fn
+
     def counters_snapshot(self) -> dict:
         with self._lock:
             return dict(sorted(self._counters.items()))
 
+    def gauges_snapshot(self) -> dict:
+        with self._lock:
+            out = dict(self._gauges)
+            fns = list(self._gauge_fns.items())
+        for name, fn in fns:
+            try:
+                out[name] = fn()
+            except Exception:  # noqa: BLE001 — gauges are best-effort
+                out[name] = None
+        return dict(sorted(out.items()))
+
     def snapshot(self) -> dict:
-        """{route: {count, errors, mean_ms, p50_ms, p95_ms, p99_ms}}"""
+        """{route: {count, errors, client_errors, server_errors,
+        mean_ms, p50_ms, p95_ms, p99_ms}}"""
         with self._lock:
             return {route: stats.snapshot()
                     for route, stats in sorted(self._routes.items())}
+
+    def prometheus_snapshot(self) -> dict:
+        """The mergeable structured view (obs/prom.py): per-route
+        counts, error classes, and latency bucket counts, plus named
+        counters and gauges."""
+        with self._lock:
+            routes = {route: stats.prometheus_snapshot()
+                      for route, stats in sorted(self._routes.items())}
+            counters = dict(sorted(self._counters.items()))
+        return {"routes": routes, "counters": counters,
+                "gauges": self.gauges_snapshot()}

@@ -22,6 +22,7 @@ from ..common.config import Config
 from ..common.lang import load_instance, logging_call
 from ..kafka import utils as kafka_utils
 from ..kafka.inproc import InProcTopicProducer, resolve_broker
+from ..obs import freshness, tracer_from_config
 from ..resilience import faults
 from ..resilience.policy import (CircuitBreaker, ResilientTopicProducer,
                                  Retry, run_with_resubscribe)
@@ -102,11 +103,27 @@ class ServingLayer:
 
         routes = self._discover_routes()
         idle_ms = config.get_int(f"{api}.batch-idle-wait-ms")
+        # sampled distributed tracing (obs/trace.py; None = disabled):
+        # the request span starts at the HTTP dispatcher, the batcher
+        # splits queue-wait from device-execute under it
+        self.tracer = tracer_from_config(config, "serving")
         self.top_n_batcher = TopNBatcher(
             max_batch=config.get_int(f"{api}.max-batch"),
             pipeline=config.get_int(f"{api}.scoring-pipeline-depth"),
-            idle_wait_s=None if idle_ms < 0 else idle_ms / 1000.0)
+            idle_wait_s=None if idle_ms < 0 else idle_ms / 1000.0,
+            tracer=self.tracer)
         self.metrics = MetricsRegistry()
+        # freshness surface: update-consumer lag + model generation age
+        # from a passive tap on the replay (obs/freshness.py)
+        self._update_tap = freshness.UpdateStreamTap()
+        if self.update_broker and self.update_topic:
+            self.metrics.gauge_fn(
+                "update_lag_records",
+                freshness.topic_lag_fn(self.update_broker,
+                                       self.update_topic,
+                                       lambda: self._update_tap.consumed))
+            self.metrics.gauge_fn("model_generation_age_sec",
+                                  self._update_tap.model_age_sec)
         self.app = HttpApp(
             routes,
             context={
@@ -116,6 +133,7 @@ class ServingLayer:
                 "min_model_load_fraction": self.min_model_load_fraction,
                 "top_n_batcher": self.top_n_batcher,
                 "metrics": self.metrics,
+                "tracer": self.tracer,
             },
             read_only=self.read_only,
             user_name=self.user_name,
@@ -205,10 +223,13 @@ class ServingLayer:
         broker = resolve_broker(self.update_broker)
         # cluster heartbeats share the update topic; they are control
         # plane, not model state, and are filtered before the manager
+        # the freshness tap counts RAW records (heartbeats included) so
+        # its count compares against the topic head's raw offsets
         run_with_resubscribe(
             lambda: self.model_manager.consume(without_heartbeats(
-                broker.consume(self.update_topic, from_beginning=True,
-                               stop=self._stop))),
+                self._update_tap.wrap(
+                    broker.consume(self.update_topic, from_beginning=True,
+                                   stop=self._stop)))),
             stop=self._stop, what="serving update consumer", log=_log)
 
     def await_(self) -> None:

@@ -5,12 +5,23 @@ speed/SpeedLayer.java:58-221 — a consumer thread replays the update
 topic from the beginning into the model manager (:107-137), while the
 input stream is processed in micro-batches whose derived deltas are
 published with key "UP" (SpeedLayerUpdate.java:37-65, async producer).
+
+Observability (docs/OBSERVABILITY.md): the tier is headless, so its
+freshness gauges — input/update consumer lag, model generation age,
+micro-batch duration, and the end-to-end ``ingest_to_servable_ms``
+measured from the ``ts`` record headers the serving front end stamps —
+are served by the side-door ObsServer on ``oryx.obs.metrics-port``.
+Records carrying a ``traceparent`` header (sampled ``/ingest``-family
+requests) get a retroactive ``speed.fold_in`` span attached to their
+originating trace, so a client request can be followed to the update
+that made it servable.
 """
 
 from __future__ import annotations
 
 import logging
 import threading
+import time
 
 from ..common import compile_cache
 from ..common.config import Config
@@ -18,9 +29,13 @@ from ..common.lang import load_instance, logging_call
 from ..kafka import utils as kafka_utils
 from ..kafka.api import KEY_UP, KeyMessage
 from ..kafka.inproc import InProcTopicProducer, resolve_broker
+from ..obs import freshness, tracer_from_config
+from ..obs.server import ObsServer
+from ..obs.trace import parse_traceparent
 from ..resilience import faults
 from ..resilience.policy import (ResilientTopicProducer, Retry,
                                  run_with_resubscribe)
+from .metrics import MetricsRegistry
 
 _log = logging.getLogger(__name__)
 
@@ -51,10 +66,27 @@ class SpeedLayer:
         self._producer = ResilientTopicProducer(
             InProcTopicProducer(self.update_broker, self.update_topic),
             retry=Retry.from_config("speed-publish", config))
+        # freshness surface (obs/freshness.py), read via the side-door
+        # ObsServer — the speed tier serves no public HTTP of its own
+        self.metrics = MetricsRegistry()
+        self.tracer = tracer_from_config(config, "speed")
+        self._update_tap = freshness.UpdateStreamTap()
+        self.metrics.gauge_fn(
+            "update_lag_records",
+            freshness.topic_lag_fn(self.update_broker, self.update_topic,
+                                   lambda: self._update_tap.consumed))
+        self.metrics.gauge_fn("model_generation_age_sec",
+                              self._update_tap.model_age_sec)
+        self.metrics.gauge_fn(
+            "input_lag_records",
+            freshness.group_lag_fn(self.input_broker, self.input_topic,
+                                   self._group))
+        self.obs_server = ObsServer(config, self.metrics, self.tracer)
 
     def start(self) -> None:
         _log.info("Starting speed layer (micro-batch %ds)",
                   self.generation_interval_sec)
+        self.obs_server.start()
         # JVM-parity cold start: fold-in kernels reload from disk cache
         compile_cache.enable_from_config(self.config)
         # create the input topic at its configured partition count before
@@ -80,6 +112,7 @@ class SpeedLayer:
     def close(self) -> None:
         self._stop.set()
         self.model_manager.close()
+        self.obs_server.close()
         for t in (self._consume_thread, self._batch_thread):
             if t:
                 t.join(10.0)
@@ -89,11 +122,41 @@ class SpeedLayer:
         # serving-cluster heartbeats ride the same update topic; they
         # are control plane, filtered before the model manager
         from ..cluster.membership import without_heartbeats
+        # the freshness tap counts RAW records (heartbeats included) so
+        # its count compares against the topic head's raw offsets
         run_with_resubscribe(
             lambda: self.model_manager.consume(without_heartbeats(
-                broker.consume(self.update_topic, from_beginning=True,
-                               stop=self._stop))),
+                self._update_tap.wrap(
+                    broker.consume(self.update_topic, from_beginning=True,
+                                   stop=self._stop)))),
             stop=self._stop, what="speed update consumer", log=_log)
+
+    def _note_micro_batch(self, new_data: list[KeyMessage],
+                          n_updates: int, t_start: float) -> None:
+        """Per-micro-batch freshness gauges + retroactive fold-in spans
+        for records whose ``traceparent`` header carries a sampled
+        trace (obs/trace.py) — strictly best-effort, after the commit-
+        ordering-critical work is done."""
+        now = time.monotonic()
+        self.metrics.set_gauge("micro_batch_duration_ms",
+                               round((now - t_start) * 1000.0, 3))
+        self.metrics.set_gauge("micro_batch_records", len(new_data))
+        oldest = freshness.oldest_ingest_ts_ms(new_data)
+        if oldest is not None:
+            # worst case across the batch: the longest a record waited
+            # between its /ingest and its deltas becoming servable
+            self.metrics.set_gauge(
+                "ingest_to_servable_ms",
+                max(0, int(time.time() * 1000) - oldest))
+        if self.tracer is None:
+            return
+        for km in new_data:
+            ctx = parse_traceparent((km.headers or {}).get("traceparent"))
+            if ctx is None or not ctx[2]:
+                continue
+            self.tracer.record_span(
+                "speed.fold_in", (ctx[0], ctx[1]), t_start, now,
+                {"batch_records": len(new_data), "updates": n_updates})
 
     def _micro_batch_loop(self) -> None:
         broker = resolve_broker(self.input_broker)
@@ -114,16 +177,20 @@ class SpeedLayer:
                 ends = broker.latest_offsets(self.input_topic)
                 if all(e <= p for e, p in zip(ends, pos)):
                     continue
+                t_batch = time.monotonic()
                 new_data = broker.read_ranges(self.input_topic, pos, ends)
                 updates = self.model_manager.build_updates(new_data)
+                n_updates = 0
                 for update in updates:
                     self._producer.send(KEY_UP, update)
+                    n_updates += 1
                 # commit BEFORE advancing the in-memory position: a
                 # failed commit must leave pos behind so the batch
                 # redelivers next interval (duplicate UP deltas are
                 # at-least-once; a silently stale broker offset is not)
                 broker.set_offsets(self._group, self.input_topic, ends)
                 pos = ends
+                self._note_micro_batch(new_data, n_updates, t_batch)
             except Exception:  # noqa: BLE001 — micro-batch failure is
                 _log.exception("Micro-batch failed")  # survivable
                 # pos is unchanged unless the commit landed, so the
@@ -137,10 +204,14 @@ class SpeedLayer:
         ends = broker.latest_offsets(self.input_topic)
         if all(e <= p for e, p in zip(ends, pos)):
             return
+        t_batch = time.monotonic()
         new_data = broker.read_ranges(self.input_topic, pos, ends)
+        n_updates = 0
         for update in self.model_manager.build_updates(new_data):
             # chaos seam: UP delta publish failure — offsets must not
             # advance past an unpublished delta
             faults.fire("speed-publish")
             self._producer.send(KEY_UP, update)
+            n_updates += 1
         broker.set_offsets(self._group, self.input_topic, ends)
+        self._note_micro_batch(new_data, n_updates, t_batch)

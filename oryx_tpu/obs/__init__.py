@@ -1,0 +1,20 @@
+"""End-to-end observability for the lambda runtime (docs/OBSERVABILITY.md).
+
+- ``trace``   — sampled span tracer, W3C traceparent propagation
+- ``prom``    — mergeable fixed-bucket histograms + Prometheus text
+- ``profile`` — on-demand ``jax.profiler`` capture
+- ``server``  — shared /metrics + /admin/* resources and the headless
+  tiers' side-door metrics server
+"""
+
+from .prom import (LATENCY_BUCKETS_MS, Histogram, merge_histograms,
+                   merge_snapshots, render_prometheus,
+                   render_prometheus_blocks)
+from .trace import (NOOP_SPAN, Span, Tracer, format_traceparent,
+                    parse_traceparent, tracer_from_config)
+
+__all__ = ["LATENCY_BUCKETS_MS", "Histogram", "merge_histograms",
+           "merge_snapshots", "render_prometheus",
+           "render_prometheus_blocks", "NOOP_SPAN", "Span",
+           "Tracer", "format_traceparent", "parse_traceparent",
+           "tracer_from_config"]

@@ -1,0 +1,99 @@
+"""On-demand device profiling: ``/admin/profile?ms=N``.
+
+The batch tier already captures a per-generation ``jax.profiler`` trace
+when ``oryx.ml.profile-dir`` is set (ml/mlupdate.py) — the TPU answer
+to the reference's per-layer Spark UI.  Serving had nothing: when a
+replica's latency regresses in production, the operator needs a device
+trace of LIVE traffic, captured without a restart.  This module powers
+the ``/admin/profile`` endpoint on every HTTP-serving tier: it records
+a bounded-duration ``jax.profiler`` trace (viewable in
+TensorBoard/Perfetto) plus device memory statistics into
+``oryx.obs.profile-dir``.
+
+Gated twice: the endpoint 404s unless ``oryx.obs.profile-dir`` is
+configured, and it is a mutating route, so DIGEST auth (when
+configured) and read-only mode both apply.  One capture at a time per
+process — ``jax.profiler`` is a process-global singleton — with
+concurrent requests refused as 503 rather than queued.
+
+Chaos seam ``obs-profile-slow`` fires inside the capture window so the
+resilience suite can prove a stalled profiler never blocks serving
+traffic (captures run on the request's own handler thread).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+
+from ..resilience import faults
+
+_log = logging.getLogger(__name__)
+
+__all__ = ["capture_profile", "ProfileBusyError"]
+
+# hard ceiling on one capture: a fat-fingered ms=3600000 must not pin
+# the profiler (and one handler thread) for an hour
+_MAX_CAPTURE_MS = 60_000
+
+_capture_lock = threading.Lock()
+
+
+class ProfileBusyError(Exception):
+    """Another capture is already in flight in this process."""
+
+
+def _device_memory_stats() -> list[dict]:
+    """Per-device memory statistics, where the backend exposes them
+    (TPU/GPU runtimes do; plain CPU returns an empty list)."""
+    try:
+        import jax
+        out = []
+        for d in jax.local_devices():
+            stats = None
+            try:
+                stats = d.memory_stats()
+            except Exception:  # noqa: BLE001 — backend-dependent
+                stats = None
+            out.append({"device": str(d),
+                        "platform": d.platform,
+                        "memory_stats": stats})
+        return out
+    except Exception:  # noqa: BLE001 — no jax, no stats
+        return []
+
+
+def capture_profile(profile_dir: str, ms: int) -> dict:
+    """Record a ``jax.profiler`` trace of the next ``ms`` milliseconds
+    of live device activity under ``profile_dir``, returning the trace
+    path and device memory stats.  Raises :class:`ProfileBusyError`
+    when a capture is already running."""
+    ms = max(1, min(int(ms), _MAX_CAPTURE_MS))
+    if not _capture_lock.acquire(blocking=False):
+        raise ProfileBusyError("a profile capture is already running")
+    try:
+        import jax
+        trace_dir = os.path.join(profile_dir,
+                                 f"profile-{int(time.time() * 1000)}")
+        os.makedirs(trace_dir, exist_ok=True)
+        t0 = time.monotonic()
+        jax.profiler.start_trace(trace_dir)
+        try:
+            # chaos seam: a stalled profiler backend — the capture slows
+            # but serving threads are untouched (this runs on the
+            # requesting handler's thread only)
+            faults.fire("obs-profile-slow")
+            time.sleep(ms / 1000.0)
+        finally:
+            jax.profiler.stop_trace()
+        wall_ms = round((time.monotonic() - t0) * 1000.0, 1)
+        _log.info("Captured device profile (%s ms) to %s", wall_ms,
+                  trace_dir)
+        return {"trace_dir": trace_dir,
+                "requested_ms": ms,
+                "captured_ms": wall_ms,
+                "devices": _device_memory_stats()}
+    finally:
+        _capture_lock.release()

@@ -1,0 +1,188 @@
+"""Prometheus text exposition over mergeable fixed-bucket histograms.
+
+The serving tier's original latency surface is a percentile reservoir
+(lambda_rt/metrics.py): exact for one process, but percentiles cannot
+be combined across replicas — the router fronting N shard replicas had
+no honest cluster-wide latency view.  Borgmon/Prometheus solved this
+with fixed-bucket histograms: bucket counts are plain counters, so the
+router can sum each bucket across replicas and the merged histogram is
+EXACTLY the histogram a single process observing all requests would
+have recorded.  This module owns the bucket layout, the merge, and the
+text exposition (`/metrics?format=prometheus`); the JSON reservoir
+percentiles stay the per-process default.
+
+All metric names are catalogued in docs/OBSERVABILITY.md and linted by
+tests/test_obs_catalog.py.
+"""
+
+from __future__ import annotations
+
+from bisect import bisect_left
+from typing import Iterable, Mapping
+
+__all__ = ["LATENCY_BUCKETS_MS", "Histogram", "merge_histograms",
+           "merge_snapshots", "render_prometheus",
+           "render_prometheus_blocks"]
+
+# Fixed latency bucket upper bounds (milliseconds).  Fixed — never
+# per-process adaptive — because exact cross-replica merging requires
+# every process to bucket identically; the range spans a local cache
+# hit (~1 ms) to the 10 s shard-timeout ceiling.
+LATENCY_BUCKETS_MS = (1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0, 200.0,
+                      500.0, 1000.0, 2000.0, 5000.0, 10000.0)
+
+
+class Histogram:
+    """Fixed-bucket latency histogram.  Not thread-safe by itself — the
+    owning MetricsRegistry serializes observes under its lock."""
+
+    __slots__ = ("counts", "sum_ms")
+
+    def __init__(self):
+        # one count per bucket plus the +Inf overflow bucket; counts are
+        # PER-bucket here and cumulated only at exposition time
+        self.counts = [0] * (len(LATENCY_BUCKETS_MS) + 1)
+        self.sum_ms = 0.0
+
+    def observe(self, ms: float) -> None:
+        self.counts[bisect_left(LATENCY_BUCKETS_MS, ms)] += 1
+        self.sum_ms += ms
+
+    def snapshot(self) -> dict:
+        return {"buckets": list(self.counts),
+                "sum_ms": round(self.sum_ms, 3)}
+
+
+def merge_histograms(snaps: Iterable[Mapping]) -> dict:
+    """Sum histogram snapshots bucket-wise — the exact merge reservoir
+    percentiles cannot provide."""
+    counts = [0] * (len(LATENCY_BUCKETS_MS) + 1)
+    total = 0.0
+    for s in snaps:
+        for i, c in enumerate(s.get("buckets") or ()):
+            counts[i] += int(c)
+        total += float(s.get("sum_ms") or 0.0)
+    return {"buckets": counts, "sum_ms": round(total, 3)}
+
+
+def merge_snapshots(snaps: Iterable[Mapping]) -> dict:
+    """Merge per-process ``MetricsRegistry.prometheus_snapshot()`` dicts
+    (route counts, error counts, latency buckets, named counters) into
+    one cluster-wide snapshot.  Gauges do not merge (they are
+    per-process instantaneous values) and are dropped."""
+    routes: dict[str, dict] = {}
+    counters: dict[str, int] = {}
+    for snap in snaps:
+        for route, r in (snap.get("routes") or {}).items():
+            agg = routes.get(route)
+            if agg is None:
+                agg = routes[route] = {
+                    "count": 0, "client_errors": 0, "server_errors": 0,
+                    "latency_ms": {"buckets": [0] * (
+                        len(LATENCY_BUCKETS_MS) + 1), "sum_ms": 0.0}}
+            agg["count"] += int(r.get("count") or 0)
+            agg["client_errors"] += int(r.get("client_errors") or 0)
+            agg["server_errors"] += int(r.get("server_errors") or 0)
+            agg["latency_ms"] = merge_histograms(
+                [agg["latency_ms"], r.get("latency_ms") or {}])
+        for name, v in (snap.get("counters") or {}).items():
+            counters[name] = counters.get(name, 0) + int(v)
+    return {"routes": dict(sorted(routes.items())),
+            "counters": dict(sorted(counters.items()))}
+
+
+def _escape(value: str) -> str:
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _labels(pairs: dict[str, str]) -> str:
+    if not pairs:
+        return ""
+    inner = ",".join(f'{k}="{_escape(v)}"' for k, v in pairs.items())
+    return "{" + inner + "}"
+
+
+def _num(v) -> str:
+    f = float(v)
+    return str(int(f)) if f == int(f) else repr(f)
+
+
+def render_prometheus(snap: Mapping,
+                      labels: dict[str, str] | None = None) -> str:
+    """Render one snapshot (a process's own, or a merged cluster view)
+    in the Prometheus text exposition format (0.0.4)."""
+    return render_prometheus_blocks([(snap, labels or {})])
+
+
+def render_prometheus_blocks(
+        blocks: list[tuple[Mapping, dict[str, str]]]) -> str:
+    """Render several ``(snapshot, base_labels)`` blocks as ONE
+    exposition — the router scrape carries its own samples
+    (``tier="router"``) and the merged replica view
+    (``tier="replica"``) together.  The text format allows exactly one
+    ``# TYPE`` line per metric name and requires all of a metric's
+    samples to form one contiguous group, so each family is emitted
+    once across all blocks, never per block."""
+    out: list[str] = []
+    with_routes = [(snap.get("routes") or {}, dict(base))
+                   for snap, base in blocks if snap.get("routes")]
+    if with_routes:
+        out.append("# TYPE oryx_requests_total counter")
+        for routes, base in with_routes:
+            for route, r in routes.items():
+                out.append("oryx_requests_total"
+                           + _labels({**base, "route": route})
+                           + f" {int(r.get('count') or 0)}")
+        out.append("# TYPE oryx_request_errors_total counter")
+        for routes, base in with_routes:
+            for route, r in routes.items():
+                for cls, key in (("client", "client_errors"),
+                                 ("server", "server_errors")):
+                    out.append("oryx_request_errors_total"
+                               + _labels({**base, "route": route,
+                                          "class": cls})
+                               + f" {int(r.get(key) or 0)}")
+        out.append("# TYPE oryx_request_latency_ms histogram")
+        for routes, base in with_routes:
+            for route, r in routes.items():
+                hist = r.get("latency_ms") or {}
+                counts = hist.get("buckets") or []
+                cum = 0
+                for bound, c in zip(LATENCY_BUCKETS_MS, counts):
+                    cum += int(c)
+                    out.append("oryx_request_latency_ms_bucket"
+                               + _labels({**base, "route": route,
+                                          "le": _num(bound)})
+                               + f" {cum}")
+                cum += int(counts[-1]) if counts else 0
+                out.append("oryx_request_latency_ms_bucket"
+                           + _labels({**base, "route": route,
+                                      "le": "+Inf"}) + f" {cum}")
+                out.append("oryx_request_latency_ms_sum"
+                           + _labels({**base, "route": route})
+                           + f" {_num(hist.get('sum_ms') or 0.0)}")
+                out.append("oryx_request_latency_ms_count"
+                           + _labels({**base, "route": route})
+                           + f" {cum}")
+    for kind, suffix in (("counters", "_total"), ("gauges", "")):
+        names: list[str] = []
+        for snap, _ in blocks:
+            for n in (snap.get(kind) or {}):
+                if n not in names:
+                    names.append(n)
+        for name in sorted(names):
+            samples = []
+            for snap, base in blocks:
+                v = (snap.get(kind) or {}).get(name)
+                if v is None:
+                    continue
+                v = int(v) if kind == "counters" else _num(v)
+                samples.append(f"oryx_{name}{suffix}"
+                               f"{_labels(dict(base))} {v}")
+            if samples:
+                out.append(f"# TYPE oryx_{name}{suffix} "
+                           + ("counter" if kind == "counters"
+                              else "gauge"))
+                out.extend(samples)
+    return "\n".join(out) + "\n" if out else ""

@@ -1,0 +1,163 @@
+"""Shared observability HTTP resources + the side-door metrics server.
+
+Three handlers every tier mounts (the serving tier and the router on
+their main port, via serving/framework.py and cluster/router.py):
+
+- ``GET /metrics`` — JSON by default; ``?format=prometheus`` renders
+  the text exposition, ``?format=prometheus-json`` returns the
+  structured mergeable snapshot the router scrapes from replicas.
+- ``GET /admin/traces`` — the tracer's bounded ring of finished
+  traces, joined across tiers by trace id.
+- ``GET /admin/profile?ms=N`` — on-demand ``jax.profiler`` capture
+  (obs/profile.py); 404 unless ``oryx.obs.profile-dir`` is set, and a
+  mutating route so DIGEST auth / read-only gating apply.
+
+The speed and batch layers serve no public HTTP, so their freshness
+gauges and fold-in traces would otherwise be invisible;
+:class:`ObsServer` is the side door — a minimal HttpApp hosting exactly
+these routes on ``oryx.obs.metrics-port`` (null = off, 0 = ephemeral).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ..api.serving import OryxServingException
+from ..lambda_rt.http import (HttpApp, Request, Route, TextResponse,
+                              make_server)
+from . import profile as profile_mod
+from .prom import render_prometheus
+
+_log = logging.getLogger(__name__)
+
+__all__ = ["admin_traces", "admin_profile", "registry_metrics",
+           "own_prometheus_snapshot", "prometheus_response",
+           "ObsServer"]
+
+
+def own_prometheus_snapshot(req: Request, registry) -> dict:
+    """This process's mergeable snapshot, with the tracer's degraded-
+    recording counter folded in — the one shape every tier exposes as
+    ``?format=prometheus-json`` and the router merges cluster-wide."""
+    snap = registry.prometheus_snapshot()
+    tracer = req.context.get("tracer")
+    if tracer is not None:
+        snap["counters"]["trace_record_failures"] = \
+            tracer.record_failures
+    return snap
+
+
+def prometheus_response(req: Request, registry):
+    """The non-JSON ``/metrics`` forms shared by every tier, or None
+    when the request wants the tier's own JSON view."""
+    fmt = req.q1("format", "json")
+    if fmt not in ("prometheus", "prometheus-json"):
+        return None
+    snap = own_prometheus_snapshot(req, registry)
+    if fmt == "prometheus-json":
+        return snap
+    return TextResponse(render_prometheus(snap))
+
+
+def registry_metrics(req: Request):
+    """Registry-only ``/metrics`` (the ObsServer's view: the speed and
+    batch tiers have no model manager or batcher to report on)."""
+    registry = req.context.get("metrics")
+    if registry is None:
+        raise OryxServingException(404, "metrics not enabled")
+    prom = prometheus_response(req, registry)
+    if prom is not None:
+        return prom
+    out = {"routes": registry.snapshot(),
+           "counters": registry.counters_snapshot()}
+    gauges = registry.gauges_snapshot()
+    if gauges:
+        out["freshness"] = gauges
+    tracer = req.context.get("tracer")
+    if tracer is not None:
+        out["obs"] = {"trace_record_failures": tracer.record_failures}
+    return out
+
+
+def admin_traces(req: Request):
+    """Finished traces from this process's bounded ring; a span tree is
+    reassembled client-side from parent ids, joining the rings of
+    router, replicas, and speed tier by trace id."""
+    tracer = req.context.get("tracer")
+    if tracer is None:
+        raise OryxServingException(
+            404, "tracing not enabled (oryx.obs.tracing.enabled)")
+    return {"service": tracer.service,
+            "record_failures": tracer.record_failures,
+            "traces": tracer.traces_snapshot(
+                limit=req.q_int("limit", 64))}
+
+
+def admin_profile(req: Request):
+    """On-demand device profile capture (obs/profile.py)."""
+    config = req.context.get("config")
+    profile_dir = config.get_optional_string("oryx.obs.profile-dir") \
+        if config is not None else None
+    if not profile_dir:
+        raise OryxServingException(
+            404, "profiling not enabled (oryx.obs.profile-dir)")
+    try:
+        return profile_mod.capture_profile(profile_dir,
+                                           req.q_int("ms", 500))
+    except profile_mod.ProfileBusyError as e:
+        raise OryxServingException(503, str(e)) from e
+
+
+OBS_ROUTES = [
+    Route("GET", "/metrics", registry_metrics),
+    Route("GET", "/admin/traces", admin_traces),
+    # mutating: captures device state to disk — read-only mode and
+    # DIGEST auth (when configured) both gate it
+    Route("GET", "/admin/profile", admin_profile, mutates=True),
+]
+
+
+class ObsServer:
+    """Minimal metrics/traces HTTP server for the headless tiers."""
+
+    def __init__(self, config, registry, tracer,
+                 port: int | None = None):
+        self.port = port if port is not None \
+            else config.get_optional_int("oryx.obs.metrics-port")
+        self._server = None
+        self._thread = None
+        # the side door honors the same gates as the main serving port:
+        # read-only mode and DIGEST creds (oryx.serving.api.*) guard
+        # the mutating /admin/profile here too
+        api = "oryx.serving.api"
+        self.app = HttpApp(OBS_ROUTES, context={
+            "metrics": registry,
+            "tracer": tracer,
+            "config": config,
+        }, read_only=config.get_bool(f"{api}.read-only"),
+           user_name=config.get_optional_string(f"{api}.user-name"),
+           password=config.get_optional_string(f"{api}.password"))
+
+    @property
+    def enabled(self) -> bool:
+        return self.port is not None
+
+    def start(self) -> None:
+        if not self.enabled or self._server is not None:
+            return
+        import threading
+        self._server = make_server(self.app, self.port)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="ObsServerHTTP")
+        self._thread.start()
+        _log.info("Observability server listening on port %d", self.port)
+
+    def close(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server = None
+        if self._thread is not None:
+            self._thread.join(5.0)
+            self._thread = None

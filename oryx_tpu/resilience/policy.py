@@ -459,12 +459,16 @@ class ResilientTopicProducer:
         self._retry = retry
         self._breaker = breaker
 
-    def send(self, key: str | None, message: str) -> None:
+    def send(self, key: str | None, message: str,
+             headers: dict | None = None) -> None:
+        # keyword pass-through only when present keeps wrapped
+        # producers whose send is (key, message)-only working untouched
+        kw = {} if headers is None else {"headers": headers}
         if self._breaker is None:
-            self._retry.call(self._inner.send, key, message)
+            self._retry.call(self._inner.send, key, message, **kw)
         else:
-            self._breaker.call(self._retry.call, self._inner.send, key,
-                               message)
+            self._breaker.call(self._retry.call, self._inner.send,
+                               key, message, **kw)
 
     def get_update_broker(self) -> str:
         return self._inner.get_update_broker()

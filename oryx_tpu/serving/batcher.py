@@ -47,10 +47,11 @@ _MAX_EXEC_S = 5.0
 
 class _Job:
     __slots__ = ("model", "how_many", "vector", "exclude", "done",
-                 "result", "error", "t_enq", "deadline")
+                 "result", "error", "t_enq", "deadline", "trace_ctx")
 
     def __init__(self, model, how_many: int, vector: np.ndarray,
-                 exclude: set[str], deadline: Deadline | None = None):
+                 exclude: set[str], deadline: Deadline | None = None,
+                 trace_ctx: tuple[str, str] | None = None):
         self.model = model
         self.how_many = how_many
         self.vector = vector
@@ -60,6 +61,9 @@ class _Job:
         self.error: BaseException | None = None
         self.t_enq = time.monotonic()
         self.deadline = deadline
+        # (trace_id, parent_span_id) captured at submit on sampled
+        # requests; None (the overwhelmingly common case) costs nothing
+        self.trace_ctx = trace_ctx
 
 
 class TopNBatcher:
@@ -68,7 +72,7 @@ class TopNBatcher:
     and each drain groups jobs by model identity."""
 
     def __init__(self, max_batch: int = 1024, pipeline: int = 32,
-                 idle_wait_s: float | None = None):
+                 idle_wait_s: float | None = None, tracer=None):
         """``pipeline`` dispatcher threads keep that many batched device
         calls in flight at once: dispatch latency (dominated by the
         host<->device round trip) overlaps instead of serializing, so
@@ -85,8 +89,14 @@ class TopNBatcher:
         next to the round trip), on a locally attached chip (measured
         round trip under ~5 ms) it is 0 — immediate dispatch.
         Configurable via oryx.serving.api.batch-idle-wait-ms
-        (-1 = adaptive)."""
+        (-1 = adaptive).
+
+        ``tracer`` (obs/trace.py, or None) splits each sampled
+        request's batcher residence into a queue-wait span and a
+        device-execute span — the evidence that separates "the device
+        is slow" from "the queue is deep"."""
         self.max_batch = max_batch
+        self._tracer = tracer
         self._idle_wait = idle_wait_s
         self._cond = threading.Condition()
         self._pending: list[_Job] = []
@@ -131,9 +141,19 @@ class TopNBatcher:
                 self.deadline_rejects += 1
             raise DeadlineExceeded("request deadline expired before "
                                    "scoring was queued")
+        trace_ctx = None
+        if self._tracer is not None:
+            # submit runs on the request's handler thread, so the
+            # thread-current span is the request span; its context is
+            # captured here because the dispatcher thread that records
+            # the queue-wait/device-execute split has no thread-local
+            # trace state of its own
+            cur = self._tracer.current()
+            if cur.sampled:
+                trace_ctx = (cur.trace_id, cur.span_id)
         job = _Job(model, how_many,
                    np.asarray(user_vector, dtype=np.float32), set(exclude),
-                   deadline=deadline)
+                   deadline=deadline, trace_ctx=trace_ctx)
         with self._cond:
             if self._stopped:
                 # shutdown race: keep-alive handler threads may outlive
@@ -285,6 +305,28 @@ class TopNBatcher:
             if stopped:
                 return
 
+    def _record_spans(self, group: list[_Job], t_exec: float,
+                      t_done: float, status: str) -> None:
+        """Queue-wait / device-execute spans for the sampled jobs of a
+        drained group.  Recorded retroactively from stored monotonic
+        stamps (the dispatcher has no thread-local trace context), and
+        strictly best-effort — the tracer absorbs recorder failures."""
+        traced = [j for j in group if j.trace_ctx is not None]
+        if not traced:
+            return
+        route = getattr(group[0].model, "kernel_route_label", None)
+        exec_attrs = {"batch_size": len(group)}
+        if route:
+            # which measured phase-A kernel variant served this drain
+            # (app/als/kernel_router.py's dispatch decision)
+            exec_attrs["kernel_route"] = route
+        for j in traced:
+            self._tracer.record_span("serving.queue_wait", j.trace_ctx,
+                                     j.t_enq, t_exec)
+            self._tracer.record_span("serving.device_execute",
+                                     j.trace_ctx, t_exec, t_done,
+                                     dict(exec_attrs), status)
+
     def _dispatch(self, jobs: list[_Job]) -> int:
         """Score a drained batch; returns how many jobs actually reached
         the device (0 = all shed, caller must not learn pacing from it)."""
@@ -319,6 +361,8 @@ class TopNBatcher:
             by_model.setdefault(id(j.model), []).append(j)
         for group in by_model.values():
             model = group[0].model
+            t_exec = time.monotonic()
+            status = "ok"
             try:
                 results = model.top_n_batch(
                     [j.how_many for j in group],
@@ -327,8 +371,12 @@ class TopNBatcher:
                 for j, r in zip(group, results):
                     j.result = r
             except BaseException as e:  # noqa: BLE001 — surfaced per job
+                status = "error"
                 for j in group:
                     j.error = e
+            if self._tracer is not None:
+                self._record_spans(group, t_exec, time.monotonic(),
+                                   status)
             with self._cond:
                 # under the lock: up to `pipeline` dispatcher threads
                 # land here concurrently, and a bare += loses updates

@@ -9,12 +9,14 @@ ErrorResource.java:36 (the error-page forward target).
 
 from __future__ import annotations
 
+import time
 import zlib
 from typing import Any
 
 from ..api.serving import OryxServingException
 from ..lambda_rt.http import (HtmlResponse, Request, Route, TextResponse,
                               render_error_page)
+from ..obs.server import admin_profile, admin_traces, prometheus_response
 from ..resilience.policy import CircuitOpenError, resilience_snapshot
 
 __all__ = ["ROUTES", "get_serving_model", "send_input"]
@@ -36,11 +38,22 @@ def send_input(req: Request, line: str) -> None:
     producer = req.context.get("input_producer")
     if producer is None:
         raise OryxServingException(403, "no input topic configured")
+    # record headers (kafka/api.py): `ts` stamps ingest wall-clock so
+    # the speed layer can measure ingest→servable freshness end to
+    # end; `traceparent` carries a sampled request's trace context so
+    # the fold-in that makes this record servable joins its trace
+    headers = {"ts": str(int(time.time() * 1000))}
+    tracer = req.context.get("tracer")
+    if tracer is not None:
+        cur = tracer.current()
+        if cur.sampled:
+            headers["traceparent"] = cur.traceparent()
     # key = hash of the message, so identical records land in the same
     # partition (reference: AbstractOryxResource.sendInput :68 sends
     # Integer.toHexString(message.hashCode()) as the key)
     try:
-        producer.send(format(zlib.crc32(line.encode("utf-8")), "x"), line)
+        producer.send(format(zlib.crc32(line.encode("utf-8")), "x"), line,
+                      headers=headers)
     except CircuitOpenError as e:
         # broker presumed down: degrade the write surface to fast 503s
         # (not 500 — the request was fine; the dependency is not) and
@@ -88,6 +101,12 @@ def _metrics(req: Request):
     registry = req.context.get("metrics")
     if registry is None:
         raise OryxServingException(404, "metrics not enabled")
+    # ?format=prometheus / prometheus-json (obs/server.py): the text
+    # exposition and the mergeable structured snapshot the cluster
+    # gateway scrapes; plain JSON stays the default
+    prom = prometheus_response(req, registry)
+    if prom is not None:
+        return prom
     model = req.context["model_manager"].get_model()
     out = {
         "routes": registry.snapshot(),
@@ -128,6 +147,14 @@ def _metrics(req: Request):
             "rejected_updates": rejected_updates,
             "rejected_models": getattr(manager, "rejected_models", 0),
         }
+    # lambda freshness gauges (obs/freshness.py): consumer lag, model
+    # generation age — evaluated on read, best-effort
+    gauges = registry.gauges_snapshot()
+    if gauges:
+        out["freshness"] = gauges
+    tracer = req.context.get("tracer")
+    if tracer is not None:
+        out["obs"] = {"trace_record_failures": tracer.record_failures}
     return out
 
 
@@ -135,4 +162,8 @@ ROUTES = [
     Route("GET", "/ready", _ready),
     Route("GET", "/error", _error),
     Route("GET", "/metrics", _metrics),
+    Route("GET", "/admin/traces", admin_traces),
+    # mutating: captures device state to disk — read-only mode and
+    # DIGEST auth (when configured) both gate it
+    Route("GET", "/admin/profile", admin_profile, mutates=True),
 ]

@@ -150,3 +150,22 @@ def test_broker_binding_keyed_sends_and_drain(mini):
     assert sorted(msgs) == sorted([f"m{i}" for i in range(12)]
                                   + ["s1", "s2"])
     b.close()
+
+
+def test_broker_binding_accepts_record_headers(mini):
+    """The widened TopicProducer protocol passes record headers; the
+    wire binding accepts them for API parity (in-proc propagates them,
+    the wire codec documents them as absent-by-default) — a real-broker
+    producer must not TypeError on a headered send (send_input always
+    attaches a `ts` header)."""
+    from oryx_tpu.kafka.client import KafkaTopicProducer
+    b = KafkaBroker(mini.bootstrap)
+    b.create_topic("kbh1", partitions=1)
+    b.send("kbh1", "k", "direct", headers={"ts": "1"})
+    p = KafkaTopicProducer(mini.bootstrap, "kbh1")
+    p.send("k", "via-producer", headers={"ts": "2",
+                                         "traceparent": "00-x"})
+    p.close()
+    msgs = [km.message for km in b.read_range("kbh1", 0, 2)]
+    assert msgs == ["direct", "via-producer"]
+    b.close()

@@ -1,0 +1,525 @@
+"""Unit tests for the observability layer (oryx_tpu/obs/,
+docs/OBSERVABILITY.md): traceparent propagation, sampling, the bounded
+trace ring, mergeable fixed-bucket histograms, Prometheus text
+exposition (golden-parsed by an in-test parser), MetricsRegistry
+error-class split / gauges / concurrency, freshness helpers, and
+record-header transport through the in-proc broker."""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+
+import numpy as np
+import pytest
+
+from oryx_tpu.kafka.api import KeyMessage
+from oryx_tpu.kafka.inproc import InProcBroker
+from oryx_tpu.lambda_rt.metrics import MetricsRegistry, _RESERVOIR
+from oryx_tpu.obs import freshness
+from oryx_tpu.obs.prom import (LATENCY_BUCKETS_MS, Histogram,
+                               merge_histograms, merge_snapshots,
+                               render_prometheus)
+from oryx_tpu.obs.trace import (NOOP_SPAN, Tracer, format_traceparent,
+                                parse_traceparent)
+from oryx_tpu.resilience import faults
+
+
+# -- traceparent --------------------------------------------------------------
+
+def test_traceparent_roundtrip():
+    tp = format_traceparent("ab" * 16, "cd" * 8, sampled=True)
+    assert parse_traceparent(tp) == ("ab" * 16, "cd" * 8, True)
+    tp0 = format_traceparent("ab" * 16, "cd" * 8, sampled=False)
+    assert parse_traceparent(tp0) == ("ab" * 16, "cd" * 8, False)
+
+
+@pytest.mark.parametrize("bad", [
+    None, "", "00-short-bad-01", "zz-" + "a" * 32 + "-" + "b" * 16 + "-01",
+    "00-" + "g" * 32 + "-" + "b" * 16 + "-01",      # non-hex trace id
+    "00-" + "0" * 32 + "-" + "b" * 16 + "-01",      # all-zero trace id
+    "00-" + "a" * 32 + "-" + "0" * 16 + "-01",      # all-zero span id
+    "00-" + "a" * 32 + "-" + "b" * 16 + "-01-extra",
+])
+def test_traceparent_malformed_starts_fresh(bad):
+    # W3C processing model: malformed context is ignored, never an error
+    assert parse_traceparent(bad) is None
+
+
+# -- tracer sampling + ring ---------------------------------------------------
+
+def test_unsampled_request_is_the_shared_noop_span():
+    t = Tracer("svc", sample_ratio=0.0)
+    span = t.begin_request("svc.request")
+    assert span is NOOP_SPAN          # no allocation on the hot path
+    assert t.span("svc.child") is NOOP_SPAN
+    # ending a noop request records nothing
+    t.end_request(span, status=200, route="r")
+    assert t.traces_snapshot() == {}
+
+
+def test_sampled_request_records_span_tree():
+    t = Tracer("svc", sample_ratio=1.0)
+    req = t.begin_request("svc.request")
+    assert req.sampled
+    with t.span("svc.child") as child:
+        child.set_attr("k", 1)
+        with t.span("svc.grandchild"):
+            pass
+    t.end_request(req, status=200, route="GET /x")
+    traces = t.traces_snapshot()
+    assert list(traces) == [req.trace_id]
+    spans = traces[req.trace_id]
+    by_name = {s["name"]: s for s in spans}
+    assert set(by_name) == {"svc.request", "svc.child", "svc.grandchild"}
+    # tree reconstructable from parent ids
+    assert by_name["svc.request"]["parent_id"] is None
+    assert by_name["svc.child"]["parent_id"] == req.span_id
+    assert by_name["svc.grandchild"]["parent_id"] == \
+        by_name["svc.child"]["span_id"]
+    assert by_name["svc.child"]["attrs"] == {"k": 1}
+    assert by_name["svc.request"]["attrs"]["http.status"] == 200
+
+
+def test_inbound_sampled_context_is_continued():
+    t = Tracer("svc", sample_ratio=0.0)  # local sampling would say no
+    tp = format_traceparent("ab" * 16, "cd" * 8, sampled=True)
+    span = t.begin_request("svc.request", tp)
+    assert span.sampled
+    assert span.trace_id == "ab" * 16
+    assert span.parent_id == "cd" * 8
+    t.end_request(span, status=200)
+    # explicitly UNsampled inbound context is honored even at ratio 1.0
+    t2 = Tracer("svc", sample_ratio=1.0)
+    tp0 = format_traceparent("ab" * 16, "cd" * 8, sampled=False)
+    assert t2.begin_request("svc.request", tp0) is NOOP_SPAN
+
+
+def test_trace_ring_evicts_oldest():
+    t = Tracer("svc", sample_ratio=1.0, max_traces=4)
+    ids = []
+    for _ in range(10):
+        span = t.begin_request("svc.request")
+        ids.append(span.trace_id)
+        t.end_request(span, status=200)
+    traces = t.traces_snapshot()
+    assert list(traces) == ids[-4:]
+
+
+def test_status_500_and_0_mark_span_error():
+    t = Tracer("svc", sample_ratio=1.0)
+    for status, want in ((200, "ok"), (404, "ok"), (500, "error"),
+                         (0, "error")):
+        span = t.begin_request("svc.request")
+        t.end_request(span, status=status)
+        spans = t.traces_snapshot()[span.trace_id]
+        assert spans[0]["status"] == want, status
+
+
+def test_record_span_retroactive():
+    t = Tracer("svc", sample_ratio=1.0)
+    t.record_span("serving.queue_wait", ("f" * 32, "e" * 16),
+                  10.0, 10.25, {"batch_size": 3})
+    spans = t.traces_snapshot()["f" * 32]
+    assert spans[0]["duration_ms"] == pytest.approx(250.0)
+    assert spans[0]["parent_id"] == "e" * 16
+    # no context (unsampled) = no record, no error
+    t.record_span("serving.queue_wait", None, 1.0, 2.0)
+
+
+def test_trace_drop_fault_degrades_to_counter():
+    """Chaos point obs-trace-drop: a raising recorder must not surface
+    to the caller — the span call succeeds, the failure is counted."""
+    t = Tracer("svc", sample_ratio=1.0)
+    faults.clear()
+    try:
+        faults.inject("obs-trace-drop", mode="error", times=1)
+        span = t.begin_request("svc.request")
+        t.end_request(span, status=200)  # must NOT raise
+        assert t.record_failures == 1
+        assert faults.fired("obs-trace-drop") == 1
+        assert t.traces_snapshot() == {}
+    finally:
+        faults.clear()
+
+
+def test_child_span_for_cross_thread_fanout():
+    t = Tracer("svc", sample_ratio=1.0)
+    req = t.begin_request("svc.request")
+    out = []
+
+    def pool_thread():
+        # thread-local current() does not follow — explicit parent does
+        assert t.current() is NOOP_SPAN
+        child = t.child_span(req, "router.shard_call")
+        child.end()
+        out.append(child)
+
+    th = threading.Thread(target=pool_thread)
+    th.start()
+    th.join()
+    t.end_request(req, status=200)
+    assert out[0].parent_id == req.span_id
+    assert t.child_span(None, "x") is NOOP_SPAN
+    assert t.child_span(NOOP_SPAN, "x") is NOOP_SPAN
+
+
+# -- histograms + merge -------------------------------------------------------
+
+def test_histogram_bucket_boundaries():
+    h = Histogram()
+    h.observe(0.5)     # < 1 ms -> first bucket
+    h.observe(1.0)     # == bound -> still le=1 (bisect_left)
+    h.observe(1.5)
+    h.observe(20000.0)  # past the last bound -> +Inf bucket
+    snap = h.snapshot()
+    assert snap["buckets"][0] == 2
+    assert snap["buckets"][1] == 1
+    assert snap["buckets"][-1] == 1
+    assert snap["sum_ms"] == pytest.approx(20003.0)
+
+
+def test_merge_histograms_is_exact_sum():
+    rng = np.random.default_rng(7)
+    parts = []
+    everything = Histogram()
+    for _ in range(3):
+        h = Histogram()
+        for ms in rng.exponential(30.0, 500):
+            h.observe(float(ms))
+            everything.observe(float(ms))
+        parts.append(h.snapshot())
+    merged = merge_histograms(parts)
+    assert merged["buckets"] == everything.snapshot()["buckets"]
+    assert merged["sum_ms"] == pytest.approx(
+        everything.snapshot()["sum_ms"])
+
+
+def test_merge_snapshots_routes_and_counters():
+    a = {"routes": {"GET /r": {"count": 3, "client_errors": 1,
+                               "server_errors": 0,
+                               "latency_ms": {"buckets": [3] + [0] * 13,
+                                              "sum_ms": 1.5}}},
+         "counters": {"partial_answers": 2}}
+    b = {"routes": {"GET /r": {"count": 2, "client_errors": 0,
+                               "server_errors": 2,
+                               "latency_ms": {"buckets": [0] * 13 + [2],
+                                              "sum_ms": 40000.0}}},
+         "counters": {"partial_answers": 1, "other": 5},
+         "gauges": {"update_lag_records": 9}}   # gauges never merge
+    m = merge_snapshots([a, b])
+    r = m["routes"]["GET /r"]
+    assert r["count"] == 5
+    assert r["client_errors"] == 1 and r["server_errors"] == 2
+    assert r["latency_ms"]["buckets"][0] == 3
+    assert r["latency_ms"]["buckets"][-1] == 2
+    assert m["counters"] == {"other": 5, "partial_answers": 3}
+    assert "gauges" not in m
+
+
+# -- Prometheus text exposition ----------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(\{(?P<labels>.*)\})? (?P<value>\S+)$")
+
+
+def _parse_prometheus(text: str) -> list[tuple[str, dict, float]]:
+    """Tiny text-format (0.0.4) parser: [(name, labels, value)]."""
+    out = []
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"unparseable exposition line: {line!r}"
+        labels = {}
+        if m.group("labels"):
+            for part in re.findall(r'([a-zA-Z_][a-zA-Z0-9_]*)="([^"]*)"',
+                                   m.group("labels")):
+                labels[part[0]] = part[1]
+        out.append((m.group("name"), labels, float(m.group("value"))))
+    return out
+
+
+def test_render_prometheus_golden():
+    reg = MetricsRegistry()
+    reg.record("GET /recommend/{userID}", 200, 0.0105)
+    reg.record("GET /recommend/{userID}", 200, 0.120)
+    reg.record("GET /recommend/{userID}", 404, 0.0007)
+    reg.record("GET /recommend/{userID}", 503, 30.0)
+    reg.inc("partial_answers")
+    reg.set_gauge("update_lag_records", 4)
+    text = render_prometheus(reg.prometheus_snapshot(),
+                             labels={"tier": "router"})
+    samples = _parse_prometheus(text)
+    by = {(n, tuple(sorted(l.items()))): v for n, l, v in samples}
+    route = ("route", "GET /recommend/{userID}")
+    tier = ("tier", "router")
+    assert by[("oryx_requests_total", (route, tier))] == 4
+    assert by[("oryx_request_errors_total",
+               (("class", "client"), route, tier))] == 1
+    assert by[("oryx_request_errors_total",
+               (("class", "server"), route, tier))] == 1
+    assert by[("oryx_partial_answers_total", (tier,))] == 1
+    assert by[("oryx_update_lag_records", (tier,))] == 4
+    # histogram: cumulative buckets, final bucket == count
+    buckets = [(l["le"], v) for n, l, v in samples
+               if n == "oryx_request_latency_ms_bucket"]
+    values = [v for _, v in buckets]
+    assert values == sorted(values)  # cumulative is monotone
+    assert buckets[-1][0] == "+Inf"
+    count = by[("oryx_request_latency_ms_count", (route, tier))]
+    assert buckets[-1][1] == count == 4
+    # bucket sums consistent with observations
+    le_ms = {le: v for le, v in buckets}
+    assert le_ms["1"] == 1         # the 0.7 ms 404
+    assert le_ms["20"] == 2        # + the 10.5 ms hit
+    assert le_ms["200"] == 3       # + the 120 ms hit
+    assert le_ms["10000"] == 3     # the 30 s outlier is +Inf only
+    assert by[("oryx_request_latency_ms_sum", (route, tier))] == \
+        pytest.approx(0.7 + 10.5 + 120.0 + 30000.0, rel=1e-6)
+
+
+def test_label_escaping():
+    text = render_prometheus(
+        {"routes": {}, "counters": {"c": 1}},
+        labels={"tier": 'we"ird\\na\nme'})
+    line = [ln for ln in text.splitlines()
+            if ln.startswith("oryx_c_total")][0]
+    assert '\\"' in line and "\\\\" in line and "\\n" in line
+    assert "\n" not in line
+
+
+# -- MetricsRegistry ----------------------------------------------------------
+
+def test_error_class_split():
+    reg = MetricsRegistry()
+    for status in (200, 204, 301, 404, 451, 500, 503, 0):
+        reg.record("GET /r", status, 0.001)
+    snap = reg.snapshot()["GET /r"]
+    assert snap["client_errors"] == 2            # 404, 451
+    assert snap["server_errors"] == 3            # 500, 503, 0 (conn died)
+    assert snap["errors"] == 5                   # back-compat total
+    assert snap["count"] == 8
+
+
+def test_gauges_snapshot_best_effort():
+    reg = MetricsRegistry()
+    reg.set_gauge("micro_batch_duration_ms", 12.5)
+    reg.gauge_fn("update_lag_records", lambda: 7)
+
+    def boom():
+        raise RuntimeError("broker down")
+
+    reg.gauge_fn("input_lag_records", boom)
+    g = reg.gauges_snapshot()
+    assert g["micro_batch_duration_ms"] == 12.5
+    assert g["update_lag_records"] == 7
+    assert g["input_lag_records"] is None        # raising fn = null
+
+
+def test_reservoir_wraparound_percentiles():
+    reg = MetricsRegistry()
+    n = _RESERVOIR + 500
+    # old slow values must be overwritten by the newest _RESERVOIR
+    for i in range(n):
+        ms = 1000.0 if i < 500 else 1.0
+        reg.record("GET /r", 200, ms / 1000.0)
+    snap = reg.snapshot()["GET /r"]
+    assert snap["count"] == n
+    assert snap["p99_ms"] == pytest.approx(1.0)  # the 1000s aged out
+
+
+def test_registry_concurrent_record_inc_snapshot():
+    reg = MetricsRegistry()
+    threads_n, per_thread = 8, 2000
+    stop = threading.Event()
+
+    def writer(k):
+        for i in range(per_thread):
+            reg.record(f"GET /r{k % 2}", 200 if i % 10 else 500,
+                       0.001 * (i % 7))
+            reg.inc("partial_answers")
+            reg.set_gauge("update_lag_records", i)
+
+    def reader():
+        while not stop.is_set():
+            s = reg.snapshot()
+            for r in s.values():
+                # totals are internally consistent at every instant
+                assert r["client_errors"] + r["server_errors"] \
+                    <= r["count"]
+            reg.prometheus_snapshot()
+            reg.gauges_snapshot()
+
+    writers = [threading.Thread(target=writer, args=(k,))
+               for k in range(threads_n)]
+    readers = [threading.Thread(target=reader) for _ in range(2)]
+    for t in readers + writers:
+        t.start()
+    for t in writers:
+        t.join()
+    stop.set()
+    for t in readers:
+        t.join()
+    snap = reg.snapshot()
+    total = sum(r["count"] for r in snap.values())
+    assert total == threads_n * per_thread
+    assert sum(r["server_errors"] for r in snap.values()) == \
+        threads_n * (per_thread // 10)
+    assert reg.counters_snapshot()["partial_answers"] == total
+    prom = reg.prometheus_snapshot()
+    for route, r in prom["routes"].items():
+        assert sum(r["latency_ms"]["buckets"]) == r["count"]
+
+
+# -- freshness helpers --------------------------------------------------------
+
+def test_update_stream_tap_counts_and_model_age():
+    tap = freshness.UpdateStreamTap()
+    assert tap.model_age_sec() is None
+    records = [KeyMessage("UP", "x"), KeyMessage("MODEL", "doc"),
+               KeyMessage("UP", "y")]
+    assert list(tap.wrap(iter(records))) == records
+    assert tap.consumed == 3
+    assert tap.model_age_sec() is not None
+    # re-wrap resets the count (resubscribe replays from zero)
+    assert list(tap.wrap(iter(records[:1]))) == records[:1]
+    assert tap.consumed == 1
+
+
+def test_oldest_ingest_ts():
+    kms = [KeyMessage(None, "a", {"ts": "1000"}),
+           KeyMessage(None, "b", {"ts": "500"}),
+           KeyMessage(None, "c", None),
+           KeyMessage(None, "d", {"ts": "junk"}),
+           KeyMessage(None, "e", {"other": "1"})]
+    assert freshness.oldest_ingest_ts_ms(kms) == 500
+    assert freshness.oldest_ingest_ts_ms(kms[2:]) is None
+
+
+# -- record headers through the in-proc broker --------------------------------
+
+def test_inproc_broker_header_roundtrip():
+    broker = InProcBroker()
+    broker.send("T", "k", "m1", headers={"ts": "123",
+                                         "traceparent": "00-x"})
+    broker.send("T", "k", "m2")
+    got = broker.read_ranges("T", [0], [2])
+    assert got[0].headers == {"ts": "123", "traceparent": "00-x"}
+    assert got[1].headers is None
+    seen = []
+    stop = threading.Event()
+    for km in broker.consume("T", from_beginning=True, stop=stop):
+        seen.append(km)
+        if len(seen) == 2:
+            stop.set()
+    assert seen[0].headers == {"ts": "123", "traceparent": "00-x"}
+
+
+def test_file_broker_headers_persist_and_old_logs_read_back(tmp_path):
+    """Headers serialize as an optional third JSONL element; a log
+    written by an older (two-element) process reads back unchanged."""
+    old = tmp_path / "OldT.topic.jsonl"
+    old.write_text(json.dumps(["k", "legacy"]) + "\n", encoding="utf-8")
+    b = InProcBroker("obs-hdr-a", persist_dir=str(tmp_path))
+    try:
+        assert b.read_ranges("OldT", [0], [1])[0] == \
+            KeyMessage("k", "legacy", None)
+        b.send("OldT", "k", "new", headers={"ts": "9"})
+        got = b.read_ranges("OldT", [0], [2])
+        assert got[1].headers == {"ts": "9"}
+    finally:
+        b.close()
+    # a fresh broker instance re-reads both record shapes from disk
+    b2 = InProcBroker("obs-hdr-b", persist_dir=str(tmp_path))
+    try:
+        got = b2.read_ranges("OldT", [0], [2])
+        assert got[0].headers is None
+        assert got[1].headers == {"ts": "9"}
+    finally:
+        b2.close()
+
+
+# -- review regressions -------------------------------------------------------
+
+def test_unsampled_shard_hop_propagates_flags00_context():
+    """The root's don't-sample decision must ride internal hops: the
+    scatter transport sends a flags-00 traceparent for unsampled
+    requests, and a downstream begin_request honors it instead of
+    re-rolling its own sampling dice."""
+    from oryx_tpu.obs.trace import unsampled_traceparent
+    tp = unsampled_traceparent()
+    parsed = parse_traceparent(tp)
+    assert parsed is not None and parsed[2] is False
+    downstream = Tracer("serving", sample_ratio=1.0)
+    span = downstream.begin_request("serving.request", tp)
+    assert span is NOOP_SPAN
+
+
+def test_obs_server_gates_mutating_profile_route():
+    """The side-door ObsServer honors read-only mode and DIGEST creds
+    (oryx.serving.api.*) exactly like the main serving port — the
+    mutating /admin/profile must not be an unauthenticated back door."""
+    import urllib.error
+    import urllib.request
+
+    from oryx_tpu.common.config import from_dict
+    from oryx_tpu.obs.server import ObsServer
+
+    def probe(extra):
+        cfg = from_dict({"oryx.obs.metrics-port": 0,
+                         "oryx.obs.profile-dir": "/tmp/obs-gate", **extra})
+        srv = ObsServer(cfg, MetricsRegistry(), None)
+        srv.start()
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/admin/profile?ms=1",
+                timeout=5)
+        except urllib.error.HTTPError as e:
+            return e.code
+        finally:
+            srv.close()
+        return 200
+
+    assert probe({"oryx.serving.api.read-only": True}) == 403
+    assert probe({"oryx.serving.api.user-name": "u",
+                  "oryx.serving.api.password": "p"}) == 401
+
+
+def test_render_blocks_single_type_line_per_family():
+    """The router's two-tier exposition must stay one valid 0.0.4
+    payload: exactly one # TYPE line per metric name, with all of a
+    family's samples contiguous behind it (strict parsers reject a
+    second TYPE line for the same name)."""
+    from oryx_tpu.obs.prom import render_prometheus_blocks
+    reg_a, reg_b = MetricsRegistry(), MetricsRegistry()
+    reg_a.record("GET /x", 200, 0.003)
+    reg_a.inc("partial_answers")
+    reg_b.record("GET /x", 200, 0.004)
+    reg_b.record("GET /y", 500, 0.2)
+    snap_b = reg_b.prometheus_snapshot()
+    snap_b["gauges"] = {"scraped_replicas": 2}
+    text = render_prometheus_blocks(
+        [(reg_a.prometheus_snapshot(), {"tier": "router"}),
+         (snap_b, {"tier": "replica"})])
+    lines = text.splitlines()
+    types = [ln for ln in lines if ln.startswith("# TYPE")]
+    assert len(types) == len(set(types)), f"duplicate TYPE lines: {types}"
+    # samples of each family form one contiguous group: every sample
+    # line belongs to the family declared by the nearest TYPE above it
+    current_family = None
+    for ln in lines:
+        if ln.startswith("# TYPE"):
+            current_family = ln.split()[2]
+            continue
+        name = ln.split("{")[0].split(" ")[0]
+        base = re.sub(r"_(bucket|sum|count)$", "", name) \
+            if current_family == "oryx_request_latency_ms" else name
+        assert base == current_family, (ln, current_family)
+    # both tiers' samples made it into the shared families
+    req_lines = [ln for ln in lines
+                 if ln.startswith("oryx_requests_total")]
+    assert any('tier="router"' in ln for ln in req_lines)
+    assert any('tier="replica"' in ln for ln in req_lines)

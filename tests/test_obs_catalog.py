@@ -1,0 +1,149 @@
+"""Metric/span name lint (ISSUE 5 satellite): every counter, gauge,
+and span name literal in the source must appear in the
+docs/OBSERVABILITY.md catalog tables and follow the naming rules —
+the same keep-the-namespace-from-rotting contract RESILIENCE.md
+already enforces for fault-point names.
+
+The walk is AST-based (not regex) so multi-line call sites and
+keyword-argument forms are seen.  Names are collected from the
+call-site surface of MetricsRegistry and Tracer:
+
+- ``.inc("<counter>")``
+- ``.set_gauge("<gauge>", ...)`` / ``.gauge_fn("<gauge>", ...)``
+- ``.span("<span>")`` / ``.child_span(parent, "<span>")`` /
+  ``.record_span("<span>", ...)``
+
+Request spans are built dynamically as ``f"{service}.request"``
+(lambda_rt/http.py), so the known service tiers' request spans are
+asserted against the catalog explicitly.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+SRC = REPO / "oryx_tpu"
+DOC = REPO / "docs" / "OBSERVABILITY.md"
+
+# snake_case on both sides of the single dot for spans; plain
+# snake_case for counters/gauges
+_SPAN_RE = re.compile(r"^[a-z][a-z0-9_]*\.[a-z][a-z0-9_]*$")
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+# (method attribute, index of the positional name argument)
+_SPAN_METHODS = {"span": 0, "child_span": 1, "record_span": 0}
+_COUNTER_METHODS = {"inc": 0}
+_GAUGE_METHODS = {"set_gauge": 0, "gauge_fn": 0}
+
+# dynamic f"{service}.request" spans (lambda_rt/http.py): one per
+# tier with an HTTP surface — router, serving, and the headless
+# tiers' side-door ObsServer — not literals the AST walk can see
+_DYNAMIC_REQUEST_SPANS = {"router.request", "serving.request",
+                          "speed.request", "batch.request"}
+
+
+def _literal_arg(call: ast.Call, index: int) -> str | None:
+    if len(call.args) > index:
+        arg = call.args[index]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            return arg.value
+    return None
+
+
+def _collect_names():
+    """{kind: {name: [file:line, ...]}} for every literal call site."""
+    found: dict[str, dict[str, list[str]]] = {
+        "span": {}, "counter": {}, "gauge": {}}
+    for path in sorted(SRC.rglob("*.py")):
+        tree = ast.parse(path.read_text(encoding="utf-8"),
+                         filename=str(path))
+        rel = path.relative_to(REPO)
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            attr = node.func.attr
+            for kind, methods in (("span", _SPAN_METHODS),
+                                  ("counter", _COUNTER_METHODS),
+                                  ("gauge", _GAUGE_METHODS)):
+                if attr in methods:
+                    name = _literal_arg(node, methods[attr])
+                    if name is not None:
+                        found[kind].setdefault(name, []).append(
+                            f"{rel}:{node.lineno}")
+    return found
+
+
+def _catalog_names() -> set[str]:
+    """Backticked names from the first cell of every catalog table row
+    in docs/OBSERVABILITY.md (prose mentions elsewhere don't count as
+    cataloguing)."""
+    names = set()
+    for line in DOC.read_text(encoding="utf-8").splitlines():
+        if not line.startswith("|"):
+            continue
+        first_cell = line.split("|")[1].strip()
+        m = re.fullmatch(r"`([^`]+)`", first_cell)
+        if m:
+            names.add(m.group(1))
+    return names
+
+
+@pytest.fixture(scope="module")
+def source_names():
+    return _collect_names()
+
+
+@pytest.fixture(scope="module")
+def catalog():
+    assert DOC.is_file(), "docs/OBSERVABILITY.md is the catalog source"
+    names = _catalog_names()
+    assert names, "no catalog tables parsed from OBSERVABILITY.md"
+    return names
+
+
+def test_walk_sees_the_known_call_sites(source_names):
+    # the lint is only as good as its walk: pin a known literal of
+    # each kind so an AST/API drift fails loudly instead of silently
+    # linting nothing
+    assert "router.merge" in source_names["span"]
+    assert "serving.queue_wait" in source_names["span"]
+    assert "partial_answers" in source_names["counter"]
+    assert "ingest_to_servable_ms" in source_names["gauge"]
+    assert "update_lag_records" in source_names["gauge"]
+
+
+def test_every_source_name_is_catalogued(source_names, catalog):
+    missing = [
+        f"{kind} {name!r} ({', '.join(sites)})"
+        for kind, names in source_names.items()
+        for name, sites in sorted(names.items())
+        if name not in catalog]
+    assert not missing, (
+        "names used in source but absent from the docs/OBSERVABILITY.md"
+        " catalog tables:\n  " + "\n  ".join(missing))
+
+
+def test_dynamic_request_spans_are_catalogued(catalog):
+    missing = _DYNAMIC_REQUEST_SPANS - catalog
+    assert not missing, (
+        f"dynamic request spans missing from the catalog: {missing}")
+
+
+def test_names_follow_the_naming_rules(source_names):
+    bad = []
+    for name, sites in sorted(source_names["span"].items()):
+        if not _SPAN_RE.fullmatch(name):
+            bad.append(f"span {name!r} must be tier.operation "
+                       f"snake_case ({', '.join(sites)})")
+    for kind in ("counter", "gauge"):
+        for name, sites in sorted(source_names[kind].items()):
+            if not _NAME_RE.fullmatch(name):
+                bad.append(f"{kind} {name!r} must be snake_case "
+                           f"({', '.join(sites)})")
+    assert not bad, "\n".join(bad)

@@ -1,0 +1,457 @@
+"""Observability integration tests (ISSUE 5 acceptance): a 2-shard
+cluster with tracing at sample-ratio 1.0 proves
+
+1. ONE trace id spans router -> both shard replicas -> the scoring
+   batcher: `router.request`, `router.merge`, per-shard
+   `router.shard_call`, each replica's `serving.request` parented
+   under its shard_call, and the batcher's `serving.queue_wait` /
+   `serving.device_execute` split — the whole tree reconstructable
+   from the per-process `/admin/traces` rings joined by trace id;
+2. the router's `/metrics?format=prometheus` merges both replicas'
+   mergeable snapshots into bucket histograms whose total counts equal
+   the sum of the replicas' own counts;
+3. a sampled `/ingest` through the router is followed into the speed
+   layer's fold-in (`traceparent` Kafka record header ->
+   `speed.fold_in` span on the same trace), and the headless tier's
+   side-door ObsServer serves its ring;
+4. the chaos points: `obs-trace-drop` (a raising span recorder never
+   fails the traced request) and `obs-profile-slow` (a stalled
+   profiler pins only the requesting handler, and concurrent captures
+   are refused 503, not queued);
+5. `/admin/profile` 404s where `oryx.obs.profile-dir` is unset and
+   captures a `jax.profiler` trace + device stats where it is set.
+
+Marker: chaos (in the tier-1 budget).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from oryx_tpu.cluster.router import RouterLayer
+from oryx_tpu.common.config import from_dict
+from oryx_tpu.kafka.inproc import get_broker
+from oryx_tpu.lambda_rt.batch import BatchLayer
+from oryx_tpu.lambda_rt.serving import ServingLayer
+from oryx_tpu.lambda_rt.speed import SpeedLayer
+from oryx_tpu.resilience import faults
+from oryx_tpu.resilience.policy import Deadline
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _config(tmp_path, broker_name, **extra):
+    overlay = {
+        "oryx.id": "obs-it",
+        "oryx.input-topic.broker": f"memory://{broker_name}",
+        "oryx.input-topic.partitions": 1,
+        "oryx.input-topic.message.topic": "OIn",
+        "oryx.update-topic.broker": f"memory://{broker_name}",
+        "oryx.update-topic.message.topic": "OUp",
+        "oryx.batch.update-class": "oryx_tpu.app.als.update.ALSUpdate",
+        "oryx.speed.model-manager-class":
+            "oryx_tpu.app.als.speed.ALSSpeedModelManager",
+        "oryx.serving.model-manager-class":
+            "oryx_tpu.app.als.serving_manager.ALSServingModelManager",
+        "oryx.serving.application-resources": "oryx_tpu.serving.als",
+        "oryx.batch.storage.data-dir": str(tmp_path / "data"),
+        "oryx.batch.storage.model-dir": str(tmp_path / "model"),
+        "oryx.als.iterations": 2,
+        "oryx.als.implicit": True,
+        "oryx.als.hyperparams.features": 3,
+        "oryx.ml.eval.test-fraction": 0.0,
+        # every request sampled: the IT asserts on recorded span trees
+        "oryx.obs.tracing.enabled": True,
+        "oryx.obs.tracing.sample-ratio": 1.0,
+        # fast cluster timings so membership transitions stay inside
+        # the tier-1 budget
+        "oryx.cluster.heartbeat-interval-ms": 60,
+        "oryx.cluster.heartbeat-ttl-ms": 400,
+        "oryx.cluster.hedge-after-ms": 50,
+        "oryx.cluster.shard-timeout-ms": 5000,
+        "oryx.resilience.retry.max-attempts": 2,
+        "oryx.resilience.retry.initial-backoff-ms": 1,
+        "oryx.resilience.retry.max-backoff-ms": 2,
+    }
+    overlay.update(extra)
+    return from_dict(overlay)
+
+
+def _produce_ratings(broker, topic, nu=16, ni=12, seed=11):
+    rng = np.random.default_rng(seed)
+    t = 1_700_000_000_000
+    for u in range(nu):
+        for i in range(ni):
+            if rng.random() < 0.5:
+                broker.send(topic, None,
+                            f"u{u},i{i},{rng.exponential(1):.2f},{t}")
+                t += 1000
+
+
+def _get(port, path, headers=None, timeout=15):
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}",
+                                 headers=headers or {})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        body = r.read()
+        ctype = r.headers.get("Content-Type", "")
+        payload = body.decode("utf-8") if "text/plain" in ctype \
+            else json.loads(body or b"null")
+        return r.status, dict(r.headers), payload
+
+
+def _post(port, path, data=b"", timeout=15):
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}",
+                                 data=data, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, dict(r.headers), json.loads(r.read() or b"null")
+
+
+def _await(predicate, what, timeout=25.0):
+    deadline = Deadline.after(timeout)
+    while not deadline.expired:
+        if predicate():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _router_ready(router):
+    try:
+        return _get(router.port, "/ready")[0] in (200, 204)
+    except (urllib.error.HTTPError, urllib.error.URLError, OSError):
+        return False
+
+
+@pytest.fixture(scope="module")
+def obs_cluster(tmp_path_factory):
+    """2-shard traced cluster + router + speed layer, one batch model."""
+    tmp_path = tmp_path_factory.mktemp("obs-it")
+    broker = get_broker("obs-it")
+    _produce_ratings(broker, "OIn")
+    profile_dir = tmp_path / "profiles"
+
+    def cfg_fn(extra=None):
+        return _config(tmp_path, "obs-it", **(extra or {}))
+
+    BatchLayer(cfg_fn()).run_one_generation()
+    replicas = []
+    for s in range(2):
+        # profile-dir only on the replicas: the router's
+        # /admin/profile must 404 (the endpoint is config-gated)
+        layer = ServingLayer(cfg_fn({
+            "oryx.cluster.enabled": True,
+            "oryx.cluster.shard": f"{s}/2",
+            "oryx.obs.profile-dir": str(profile_dir),
+        }), port=0)
+        layer.start()
+        replicas.append(layer)
+    router = RouterLayer(cfg_fn(), port=0)
+    router.start()
+    speed = SpeedLayer(cfg_fn({"oryx.obs.metrics-port": 0}))
+    speed.start()
+    _await(lambda: _router_ready(router), "router readiness")
+    _await(lambda: (m := speed.model_manager.model) is not None
+           and m.get_fraction_loaded() >= 0.8, "speed model")
+    # the first-ever jax.profiler.start_trace in a process pays a
+    # ~10 s one-time profiler init; warm it here (the profiler is
+    # process-global, so one warmup covers both in-proc replicas) so
+    # the chaos tests measure steady-state capture cost
+    _get(replicas[0].port, "/admin/profile?ms=1", timeout=90)
+    yield {"cfg_fn": cfg_fn, "replicas": replicas, "router": router,
+           "speed": speed, "broker": broker,
+           "profile_dir": profile_dir}
+    for layer in replicas + [router, speed]:
+        try:
+            layer.close()
+        except Exception:  # noqa: BLE001 — teardown best effort
+            pass
+
+
+def _user_ids(router_port):
+    _, _, ids = _get(router_port, "/allUserIDs")
+    assert ids
+    return sorted(ids)
+
+
+def _all_traces(cluster):
+    """Every tier's /admin/traces ring joined: trace id -> spans."""
+    router, replicas = cluster["router"], cluster["replicas"]
+    speed = cluster["speed"]
+    joined: dict[str, list[dict]] = {}
+    ports = [router.port] + [r.port for r in replicas] \
+        + [speed.obs_server.port]
+    for port in ports:
+        _, _, payload = _get(port, "/admin/traces")
+        for tid, spans in payload["traces"].items():
+            joined.setdefault(tid, []).extend(spans)
+    return joined
+
+
+# -- 1. one trace id across router -> replicas -> batcher --------------------
+
+def test_one_trace_spans_router_both_replicas_and_batcher(obs_cluster):
+    router = obs_cluster["router"]
+    uid = _user_ids(router.port)[0]
+    status, headers, _ = _get(router.port,
+                              f"/recommend/{uid}?howMany=8")
+    assert status == 200
+    trace_id = headers.get("X-Oryx-Trace")
+    assert trace_id, "router did not echo X-Oryx-Trace on a sampled request"
+
+    def recorded():
+        spans = _all_traces(obs_cluster).get(trace_id, [])
+        return {"serving.device_execute", "router.merge"} <= \
+            {s["name"] for s in spans}
+
+    # batcher spans are recorded retroactively by dispatcher threads —
+    # give the rings a moment to settle
+    _await(recorded, "span tree completion", timeout=5.0)
+
+    spans = _all_traces(obs_cluster)[trace_id]
+    by_name: dict[str, list[dict]] = {}
+    for s in spans:
+        by_name.setdefault(s["name"], []).append(s)
+
+    # the request span and the exact-merge span on the router
+    assert len(by_name["router.request"]) == 1
+    root = by_name["router.request"][0]
+    assert root["parent_id"] is None
+    merge = by_name["router.merge"][0]
+    assert merge["parent_id"] == root["span_id"]
+    assert merge["attrs"]["shards_merged"] == 2
+
+    # one shard_call per shard, both under the request span, and the
+    # traceparent hop means each replica's serving.request parents
+    # under ITS shard_call
+    calls = by_name["router.shard_call"]
+    assert {c["attrs"]["shard"] for c in calls} == {0, 1}
+    for c in calls:
+        assert c["parent_id"] == root["span_id"]
+    call_ids = {c["span_id"] for c in calls}
+    serv_reqs = by_name["serving.request"]
+    assert len(serv_reqs) == 2, "both replicas must record their span"
+    assert {s["parent_id"] for s in serv_reqs} <= call_ids
+    assert {s["service"] for s in serv_reqs} == {"serving"}
+
+    # the batcher split, parented under each replica's request span
+    serv_ids = {s["span_id"] for s in serv_reqs}
+    for name in ("serving.queue_wait", "serving.device_execute"):
+        got = by_name[name]
+        assert len(got) == 2, name
+        assert {s["parent_id"] for s in got} <= serv_ids, name
+    assert all(s["attrs"]["batch_size"] >= 1
+               for s in by_name["serving.device_execute"])
+
+    # the whole tree is reconstructable: every parent_id resolves
+    # within the joined trace (or is the root)
+    all_ids = {s["span_id"] for s in spans}
+    for s in spans:
+        assert s["parent_id"] is None or s["parent_id"] in all_ids, \
+            s["name"]
+    # and every span really is on the one trace
+    assert {s["trace_id"] for s in spans} == {trace_id}
+
+
+# -- 2. cluster-wide Prometheus merge -----------------------------------------
+
+_SAMPLE_RE = __import__("re").compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(\{(?P<labels>.*)\})? (?P<value>\S+)$")
+
+
+def _parse_prom(text):
+    import re
+    out = []
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"unparseable exposition line: {line!r}"
+        labels = dict(re.findall(
+            r'([a-zA-Z_][a-zA-Z0-9_]*)="([^"]*)"', m.group("labels") or ""))
+        out.append((m.group("name"), labels, float(m.group("value"))))
+    return out
+
+
+def test_router_prometheus_merges_replica_histograms(obs_cluster):
+    router, replicas = obs_cluster["router"], obs_cluster["replicas"]
+    for uid in _user_ids(router.port)[:4]:
+        _get(router.port, f"/recommend/{uid}?howMany=5")
+
+    # each replica's own mergeable snapshot (what the router scrapes)
+    replica_snaps = [
+        _get(r.port, "/metrics?format=prometheus-json")[2]
+        for r in replicas]
+    route = "GET /shard/recommend/{userID}"
+    want_count = sum(s["routes"][route]["count"] for s in replica_snaps)
+    want_buckets = [
+        sum(s["routes"][route]["latency_ms"]["buckets"][i]
+            for s in replica_snaps)
+        for i in range(len(
+            replica_snaps[0]["routes"][route]["latency_ms"]["buckets"]))]
+    assert want_count >= 4 and want_count == sum(want_buckets)
+
+    status, headers, text = _get(router.port,
+                                 "/metrics?format=prometheus")
+    assert status == 200 and isinstance(text, str)
+    samples = _parse_prom(text)
+    # the merged replica block's totals equal the sum of the replicas'
+    merged_total = [v for n, l, v in samples
+                    if n == "oryx_requests_total"
+                    and l.get("tier") == "replica"
+                    and l.get("route") == route]
+    assert merged_total == [want_count]
+    # cumulative +Inf bucket == count == the replica sum
+    inf = [v for n, l, v in samples
+           if n == "oryx_request_latency_ms_bucket"
+           and l.get("tier") == "replica" and l.get("route") == route
+           and l.get("le") == "+Inf"]
+    assert inf == [want_count]
+    # per-bucket: de-cumulate the merged text and compare exactly
+    merged_cum = [(l["le"], v) for n, l, v in samples
+                  if n == "oryx_request_latency_ms_bucket"
+                  and l.get("tier") == "replica"
+                  and l.get("route") == route]
+    merged_per = [v - (merged_cum[i - 1][1] if i else 0.0)
+                  for i, (_, v) in enumerate(merged_cum)]
+    assert merged_per == [float(b) for b in want_buckets]
+    # coverage gauge: both replicas answered the scrape
+    scraped = [v for n, l, v in samples
+               if n == "oryx_scraped_replicas"
+               and l.get("tier") == "replica"]
+    assert scraped == [2.0]
+    # the router's own block is present and separately labeled
+    assert any(n == "oryx_requests_total" and l.get("tier") == "router"
+               for n, l, v in samples)
+
+
+# -- 3. /ingest followed into the speed layer's fold-in -----------------------
+
+def test_ingest_trace_reaches_speed_fold_in(obs_cluster):
+    router, speed = obs_cluster["router"], obs_cluster["speed"]
+    broker = obs_cluster["broker"]
+    before = broker.latest_offset("OIn")
+    status, headers, _ = _post(router.port, "/pref/obsuser/i1",
+                               data=b"4.0")
+    assert status in (200, 204)
+    trace_id = headers.get("X-Oryx-Trace")
+    assert trace_id
+    _await(lambda: broker.latest_offset("OIn") > before,
+           "ingest reaching the input topic", timeout=5.0)
+    speed.run_one_micro_batch()
+
+    # the side-door ObsServer serves the headless tier's ring
+    _, _, payload = _get(speed.obs_server.port, "/admin/traces")
+    assert payload["service"] == "speed"
+    spans = payload["traces"].get(trace_id)
+    assert spans, "speed layer recorded no span on the ingest trace"
+    fold = [s for s in spans if s["name"] == "speed.fold_in"]
+    assert fold and fold[0]["attrs"]["batch_records"] >= 1
+
+    # freshness: the same micro-batch fed the end-to-end gauge from
+    # the ts record header stamped at ingest
+    _, _, metrics = _get(speed.obs_server.port, "/metrics")
+    fresh = metrics["freshness"]
+    assert fresh["ingest_to_servable_ms"] is not None
+    assert 0 <= fresh["ingest_to_servable_ms"] < 60_000
+    assert fresh["micro_batch_records"] >= 1
+
+
+# -- 4. chaos: observability is strictly best-effort --------------------------
+
+def test_trace_drop_fault_never_fails_request(obs_cluster):
+    router = obs_cluster["router"]
+    uid = _user_ids(router.port)[0]
+    fails_before = router.tracer.record_failures
+    # every span recording in the process raises while injected; the
+    # request must still answer 200 end to end (router AND replicas
+    # share the in-proc faults registry, so all tiers degrade at once)
+    faults.inject("obs-trace-drop", mode="error", times=50)
+    try:
+        status, headers, body = _get(router.port,
+                                     f"/recommend/{uid}?howMany=5")
+    finally:
+        faults.clear()
+    assert status == 200 and body
+    assert router.tracer.record_failures > fails_before
+    # the degraded recordings surface as a counter on the exposition
+    _, _, text = _get(router.port, "/metrics?format=prometheus")
+    assert any(n == "oryx_trace_record_failures_total" and v > 0
+               for n, l, v in _parse_prom(text)
+               if l.get("tier") == "router")
+
+
+def test_profile_slow_fault_pins_only_the_capture(obs_cluster):
+    replica = obs_cluster["replicas"][0]
+    router = obs_cluster["router"]
+    uid = _user_ids(router.port)[0]
+    faults.inject("obs-profile-slow", mode="delay", delay_sec=0.4,
+                  times=1)
+    box = {}
+
+    def capture():
+        try:
+            box["profile"] = _get(replica.port, "/admin/profile?ms=10")
+        except urllib.error.HTTPError as e:  # pragma: no cover
+            box["profile"] = (e.code, {}, None)
+
+    th = threading.Thread(target=capture)
+    t0 = time.monotonic()
+    th.start()
+    # while the capture stalls, serving traffic on the same replica
+    # answers normally (the profiler pins only the handler thread)
+    status, _, _ = _get(replica.port,
+                        f"/shard/recommend/{uid}?howMany=3")
+    served_ms = (time.monotonic() - t0) * 1000.0
+    assert status == 200
+    th.join(10.0)
+    assert box["profile"][0] == 200
+    assert box["profile"][2]["captured_ms"] >= 400.0
+    assert served_ms < box["profile"][2]["captured_ms"]
+
+
+# -- 5. /admin/profile gating + capture ---------------------------------------
+
+def test_admin_profile_capture_and_gating(obs_cluster):
+    import os
+    replica = obs_cluster["replicas"][0]
+    router = obs_cluster["router"]
+    # router has no profile-dir configured: the endpoint 404s
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(router.port, "/admin/profile?ms=10")
+    assert e.value.code == 404
+    # the replica captures: a real jax.profiler trace dir + devices
+    status, _, payload = _get(replica.port, "/admin/profile?ms=30")
+    assert status == 200
+    assert payload["requested_ms"] == 30
+    assert payload["captured_ms"] >= 30.0
+    assert os.path.isdir(payload["trace_dir"])
+    assert payload["trace_dir"].startswith(
+        str(obs_cluster["profile_dir"]))
+    assert isinstance(payload["devices"], list)
+
+    # concurrent captures are refused 503, never queued
+    faults.inject("obs-profile-slow", mode="delay", delay_sec=0.3,
+                  times=1)
+    th = threading.Thread(
+        target=lambda: _get(replica.port, "/admin/profile?ms=10"))
+    th.start()
+    time.sleep(0.1)
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(replica.port, "/admin/profile?ms=10")
+    assert e.value.code == 503
+    th.join(10.0)
