@@ -6,8 +6,10 @@ Two artifact families share the machinery, selected by ``--kind``:
 - ``grid`` (default): ``BENCH_GRID_*.json``, cells keyed by
   (features, items, lsh) — the single-node serving envelope.
 - ``gateway``: ``BENCH_GATEWAY_*.json``, cells keyed by
-  (features, items, replicas) — the scatter-gather cluster's
-  per-replica-count scaling rounds.
+  (features, items, replicas, replicas-per-shard) — the
+  scatter-gather cluster's per-topology scaling rounds (R-way
+  replica-group cells gate independently of their R=1 siblings;
+  pre-r09 artifacts are all R=1).
 
 Joins the two most recent rounds (by round number in the filename) on
 the cell key and exits non-zero when any cell's HEADLINE metric —
@@ -62,8 +64,11 @@ def find_gateway_artifacts(directory: str) -> list[str]:
 
 def _cells(doc: dict) -> dict:
     if doc.get("metric") == "gateway_recommend_scaling":
-        # per-replica-count scaling cells (bench/gateway.py)
-        return {(r["features"], r["items"], r["replicas"]): r
+        # per-replica-count scaling cells (bench/gateway.py); the
+        # replica-group size R joined the key in r09 — pre-elastic
+        # rounds are all R=1, so they keep gating the R=1 cells
+        return {(r["features"], r["items"], r["replicas"],
+                 r.get("replicas_per_shard", 1)): r
                 for r in doc.get("rows", [])}
     return {(r["features"], r["items"], r["lsh"]): r
             for r in doc.get("rows", [])}
@@ -71,8 +76,10 @@ def _cells(doc: dict) -> dict:
 
 def _cell_label(doc: dict, key: tuple) -> str:
     if doc.get("metric") == "gateway_recommend_scaling":
-        return (f"{key[0]}f/{key[1] / 1e6:g}M/"
-                f"{key[2]}rep")
+        label = f"{key[0]}f/{key[1] / 1e6:g}M/{key[2]}rep"
+        if key[3] != 1:
+            label += f"x{key[3]}"
+        return label
     return f"{key[0]}f/{key[1] / 1e6:g}M{'/lsh' if key[2] else ''}"
 
 

@@ -1,5 +1,8 @@
 """Gateway scale-out benchmark: sustained /recommend qps through the
-scatter-gather router at 1 -> 2 -> 4 catalog-shard replicas.
+scatter-gather router at 1 -> 2 -> 4 catalog-shard replicas, with an
+R-way replica-group dimension (``--replicas-per-shard``), a
+kill-one-member availability probe, and an admission-control overload
+rung.
 
 The cluster is real processes (``python -m oryx_tpu serving --shard
 i/N`` + ``router``) over a durable ``file://`` broker, so the scaling
@@ -28,9 +31,20 @@ direct replica merge, then walks an open-loop rate ladder
 (bench/load.py's arrival-scheduled driver) to the highest sustained
 rate.
 
-Writes ``BENCH_GATEWAY_r07.json``; ``bench/check_regression.py
+With ``--replicas-per-shard R`` every shard becomes an R-way replica
+group (R processes announcing the same ``(shard, of)``): the router
+load-balances and hedges within each group, and the bench's
+availability probe kills one member mid-load and reports the fraction
+of non-partial 200s during the kill window — the measured form of "a
+dead replica costs latency, not coverage".  ``--admission-max-inflight``
+/ ``--admission-queue-wait-ms`` arm the router's admission control and
+add an overload rung driven well past the sustained ceiling, recording
+how much of the overload degraded to fast 503 + ``Retry-After`` instead
+of collapse.
+
+Writes ``BENCH_GATEWAY_r09.json``; ``bench/check_regression.py
 --kind gateway`` gates successive rounds per (features, items,
-replicas) cell.
+replicas, replicas-per-shard) cell.
 """
 
 from __future__ import annotations
@@ -158,6 +172,155 @@ def _await(predicate, what: str, timeout: float = 300.0) -> None:
     raise RuntimeError(f"timed out waiting for {what}")
 
 
+def _get_json_retry_cold(port: int, path: str,
+                         budget_sec: float = 180.0):
+    """_get_json tolerating a COLD scoring path: the first dispatch a
+    replica ever runs includes the XLA compile of its scan ladder,
+    which can outlast the router's shard timeout — the router then
+    reads the shard as down and answers 503 (or the direct call times
+    out).  Those first-touch failures retry within the budget; any
+    other status propagates immediately."""
+    t_end = time.monotonic() + budget_sec
+    while True:
+        try:
+            return _get_json(port, path, timeout=30.0)
+        except urllib.error.HTTPError as e:
+            e.read()
+            if e.code != 503 or time.monotonic() >= t_end:
+                raise
+        except OSError:
+            if time.monotonic() >= t_end:
+                raise
+        time.sleep(1.0)
+
+
+def _probe_window(port: int, user_ids: list[str], rate_qps: float,
+                  duration_sec: float, workers: int = 24) -> list[dict]:
+    """Fixed-rate /recommend probe recording PER-RESPONSE verdicts —
+    status, the X-Oryx-Partial marker, Retry-After, latency, and the
+    completion time relative to probe start — the raw material for the
+    kill-window availability fraction and the admission overload
+    summary (the open-loop ladder driver only counts errors)."""
+    import threading as th
+    n = max(1, int(rate_qps * duration_sec))
+    results: list[dict] = []
+    lock = th.Lock()
+    next_i = [0]
+    t0 = time.monotonic()
+
+    def worker():
+        while True:
+            with lock:
+                i = next_i[0]
+                if i >= n:
+                    return
+                next_i[0] += 1
+            scheduled = t0 + i / rate_qps
+            now = time.monotonic()
+            if scheduled > now:
+                time.sleep(scheduled - now)
+            sent = time.monotonic()
+            uid = user_ids[i % len(user_ids)]
+            url = (f"http://127.0.0.1:{port}/recommend/{uid}"
+                   "?howMany=10")
+            status, partial, retry_after = 0, False, None
+            try:
+                with urllib.request.urlopen(url, timeout=30) as r:
+                    r.read()
+                    status = r.status
+                    partial = r.headers.get("X-Oryx-Partial") is not None
+            except urllib.error.HTTPError as e:
+                status = e.code
+                retry_after = e.headers.get("Retry-After")
+                e.read()
+            except Exception:  # noqa: BLE001 — transport failure
+                status = 0
+            done = time.monotonic()
+            with lock:
+                # ms is the REQUEST's own latency (send -> response),
+                # not slip against the schedule: under deliberate
+                # overload the probe's own workers starve, and a shed
+                # 503's cost must not inherit that local queueing
+                results.append({
+                    "t": done - t0,
+                    "ms": (done - sent) * 1000.0,
+                    "status": status, "partial": partial,
+                    "retry_after": retry_after})
+
+    threads = [th.Thread(target=worker, daemon=True)
+               for _ in range(workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return results
+
+
+def _kill_window_probe(router_port: int, user_ids: list[str],
+                       rate_qps: float, pre_sec: float,
+                       window_sec: float, kill_fn) -> dict:
+    """Drive steady load, kill one replica-group member at ``pre_sec``,
+    and report availability — the fraction of non-partial 200s — over
+    the kill window (kill instant to probe end, TTL expiry included:
+    hedged failover must hide the death even BEFORE age-out)."""
+    import threading as th
+    timer = th.Timer(pre_sec, kill_fn)
+    timer.start()
+    try:
+        results = _probe_window(router_port, user_ids, rate_qps,
+                                pre_sec + window_sec)
+    finally:
+        timer.cancel()  # no-op once fired
+    window = [r for r in results if r["t"] >= pre_sec]
+    ok = [r for r in window
+          if r["status"] == 200 and not r["partial"]]
+    return {
+        "rate_qps": rate_qps,
+        "window_requests": len(window),
+        "ok_full": len(ok),
+        "partials": sum(1 for r in window if r["partial"]),
+        "errors": sum(1 for r in window
+                      if r["status"] != 200),
+        "availability": round(len(ok) / len(window), 4)
+        if window else None,
+    }
+
+
+def _overload_probe(router_port: int, user_ids: list[str],
+                    rate_qps: float, duration_sec: float) -> dict:
+    """Drive the router well past its sustained ceiling with admission
+    control armed: overload must degrade to FAST 503 + Retry-After,
+    not the queueing collapse of the un-gated front end."""
+    # worker pool must exceed the admission cap, or the probe itself
+    # bounds inflight below the gate and nothing ever sheds
+    results = _probe_window(router_port, user_ids, rate_qps,
+                            duration_sec,
+                            workers=min(256, max(128,
+                                                 int(rate_qps * 1.5))))
+    ok = [r for r in results if r["status"] == 200]
+    shed = [r for r in results if r["status"] == 503]
+
+    def _p50(rows):
+        return round(float(np.percentile(
+            [r["ms"] for r in rows], 50)), 1) if rows else None
+
+    return {
+        "offered_qps": rate_qps,
+        "requests": len(results),
+        "ok_200": len(ok),
+        "shed_503": len(shed),
+        "shed_fraction": round(len(shed) / len(results), 4)
+        if results else None,
+        "shed_with_retry_after": sum(
+            1 for r in shed if r["retry_after"]),
+        "other_errors": len(results) - len(ok) - len(shed),
+        "p50_ok_ms": _p50(ok),
+        # the whole point: a shed answer costs ~a round trip, not a
+        # queue residence
+        "p50_shed_ms": _p50(shed),
+    }
+
+
 def run_cell(replicas: int, items: int, features: int, users: int,
              rates: list[float], duration_sec: float,
              replica_threads: int, work_dir: str,
@@ -165,7 +328,11 @@ def run_cell(replicas: int, items: int, features: int, users: int,
              user_ids: list[str] | None = None,
              device_ms_per_mrow: float = 0.0,
              spot_users: int = 20,
-             tracing_sample: float | None = None) -> dict:
+             tracing_sample: float | None = None,
+             replicas_per_shard: int = 1,
+             kill_member_probe: bool = False,
+             admission: dict | None = None,
+             overload_factor: float = 3.0) -> dict:
     publish_s = 0.0
     if broker_dir is None:
         broker_dir = os.path.join(work_dir, f"broker-{replicas}")
@@ -175,9 +342,15 @@ def run_cell(replicas: int, items: int, features: int, users: int,
         publish_s = time.time() - t0
 
     procs: list[subprocess.Popen] = []
-    replica_ports = [_free_port() for _ in range(replicas)]
+    # member grid: replicas shards x replicas_per_shard group members
+    members = [(s, r) for s in range(replicas)
+               for r in range(replicas_per_shard)]
+    member_ports = {m: _free_port() for m in members}
+    member_procs: dict[tuple[int, int], subprocess.Popen] = {}
+    replica_ports = list(member_ports.values())
     router_port = _free_port()
-    log_path = os.path.join(work_dir, f"cell-{replicas}.log")
+    log_path = os.path.join(
+        work_dir, f"cell-{replicas}x{replicas_per_shard}.log")
     # per-replica catalog slice: what the emulated device streams
     slice_rows = items / replicas
     try:
@@ -190,11 +363,15 @@ def run_cell(replicas: int, items: int, features: int, users: int,
                 "oryx.obs.tracing.enabled": True,
                 "oryx.obs.tracing.sample-ratio": tracing_sample,
             }
-        for s in range(replicas):
-            conf = os.path.join(work_dir, f"replica-{replicas}-{s}.conf")
+        for s, r in members:
+            conf = os.path.join(
+                work_dir,
+                f"replica-{replicas}x{replicas_per_shard}-{s}-{r}.conf")
             extra = {
                 "oryx.cluster.enabled": True,
                 "oryx.cluster.shard": f"{s}/{replicas}",
+                "oryx.cluster.replica-id":
+                    f"s{s}r{r}of{replicas}",
                 **obs_extra,
             }
             if device_ms_per_mrow > 0:
@@ -231,12 +408,28 @@ def run_cell(replicas: int, items: int, features: int, users: int,
                     "oryx.resilience.faults.serving-scan-dispatch"
                     ".delay-ms": round(delay, 3),
                 })
-            _write_conf(conf, broker_dir, replica_ports[s], extra)
-            procs.append(_spawn(["serving", "--shard",
-                                 f"{s}/{replicas}"], conf,
-                                replica_threads, log_path))
-        conf = os.path.join(work_dir, f"router-{replicas}.conf")
-        _write_conf(conf, broker_dir, router_port, dict(obs_extra))
+            _write_conf(conf, broker_dir, member_ports[(s, r)], extra)
+            proc = _spawn(["serving", "--shard", f"{s}/{replicas}"],
+                          conf, replica_threads, log_path)
+            procs.append(proc)
+            member_procs[(s, r)] = proc
+        conf = os.path.join(
+            work_dir, f"router-{replicas}x{replicas_per_shard}.conf")
+        router_extra = dict(obs_extra)
+        if device_ms_per_mrow > 0:
+            # hedge only on a genuine stall: the default 100 ms window
+            # sits far BELOW an emulated cell's per-dispatch delay, so
+            # with R-way groups nearly every request would hedge to a
+            # sibling and the duplicated work erases the group's extra
+            # capacity.  5x the dispatch delay sits past the queueing
+            # tail a sustained rung produces (p50 ~2 windows) — the
+            # production guidance of hedge-after ~ p95+.
+            delay = device_ms_per_mrow * slice_rows / 1e6
+            router_extra["oryx.cluster.hedge-after-ms"] = \
+                max(1000, int(5 * delay))
+        if admission:
+            router_extra.update(admission)
+        _write_conf(conf, broker_dir, router_port, router_extra)
         procs.append(_spawn(["router"], conf, None, log_path))
 
         def _loaded(port: int) -> bool:
@@ -255,13 +448,25 @@ def run_cell(replicas: int, items: int, features: int, users: int,
                "router coverage")
 
         # correctness spot-check: router merge == exact merge of the
-        # replicas' own /shard/recommend answers
+        # replicas' own /shard/recommend answers (one member per
+        # shard — group siblings hold identical slices and would
+        # double-count every row)
+        spot_ports = [member_ports[(s, 0)] for s in range(replicas)]
+        # first-touch scoring compiles per process: warm every member
+        # directly (so the router's first scatter never sees a shard
+        # stuck in its XLA compile and degrades to partial/503), then
+        # one request through the router itself
+        for p in member_ports.values():
+            _get_json_retry_cold(
+                p, f"/shard/recommend/{user_ids[0]}?howMany=10")
+        _get_json_retry_cold(router_port,
+                             f"/recommend/{user_ids[0]}?howMany=10")
         spot_ok = True
         for uid in user_ids[:spot_users]:
-            got = [d["id"] for d in _get_json(
+            got = [d["id"] for d in _get_json_retry_cold(
                 router_port, f"/recommend/{uid}?howMany=10")]
             rows = []
-            for p in replica_ports:
+            for p in spot_ports:
                 payload = _get_json(p, f"/shard/recommend/{uid}"
                                        "?howMany=10")
                 rows.extend(tuple(r) for r in payload["rows"])
@@ -303,10 +508,41 @@ def run_cell(replicas: int, items: int, features: int, users: int,
             print("worst-p99 sampled requests: " + ", ".join(
                 f"{w['ms']}ms trace={w['trace']}"
                 for w in best["worst_sampled"]), file=sys.stderr)
-        partials = _get_json(router_port, "/metrics")["counters"].get(
-            "partial_answers", 0)
+        m = _get_json(router_port, "/metrics")
+        partials = m["counters"].get("partial_answers", 0)
+        admission_stats = m["cluster"].get("admission")
+        scatter_stats = m["cluster"].get("scatter")
+
+        # overload rung FIRST (the cluster is still intact — a
+        # post-kill group would bias shed fraction and latency): drive
+        # well past the sustained ceiling with admission armed — the
+        # shed fraction and its p50 are the measured "fast 503" story
+        admission_overload = None
+        if admission:
+            base = best["achieved_qps"] if best else 50.0
+            admission_overload = _overload_probe(
+                router_port, user_ids, base * overload_factor,
+                max(8.0, duration_sec))
+            # let the admitted backlog (bounded by the inflight cap)
+            # drain before the availability probe is judged
+            time.sleep(6.0)
+
+        # availability probe: kill one group member under steady load;
+        # a 2-of-2 group must keep answering FULL (non-partial) 200s —
+        # hedged failover before age-out, sibling-only routing after
+        kill_probe = None
+        if kill_member_probe and replicas_per_shard > 1:
+            probe_rate = max(
+                20.0, (best["achieved_qps"] if best else 40.0) * 0.5)
+            victim = member_procs[(0, replicas_per_shard - 1)]
+            kill_probe = _kill_window_probe(
+                router_port, user_ids, probe_rate, pre_sec=3.0,
+                window_sec=max(8.0, duration_sec),
+                kill_fn=victim.kill)
+
         return {
             "replicas": replicas,
+            "replicas_per_shard": replicas_per_shard,
             "items": items,
             "features": features,
             "users": users,
@@ -327,6 +563,11 @@ def run_cell(replicas: int, items: int, features: int, users: int,
                 best["achieved_qps"] if best else 0.0,
             "sustained_p50_ms": best["p50_ms"] if best else None,
             "sustained_p95_ms": best["p95_ms"] if best else None,
+            "kill_probe": kill_probe,
+            "admission": admission or None,
+            "admission_stats_after_ladder": admission_stats,
+            "scatter_stats_after_ladder": scatter_stats,
+            "admission_overload": admission_overload,
             "ladder": ladder,
         }
     finally:
@@ -375,7 +616,42 @@ def main(argv: list[str] | None = None) -> int:
                          "UNsampled per-request overhead, 1.0 records "
                          "every request).  Default: tracing off — the "
                          "shipped configuration")
-    ap.add_argument("--out", default="BENCH_GATEWAY_r07.json")
+    ap.add_argument("--replicas-per-shard", default="1",
+                    help="comma list of group sizes R: each (replicas, "
+                         "R) pair is a cell with R serving processes "
+                         "per shard announcing the same (shard, of) — "
+                         "the router load-balances, hedges, and fails "
+                         "over within each group")
+    ap.add_argument("--cells", default="",
+                    help="explicit comma list of NxR cells (e.g. "
+                         "1x1,1x2,2x1), overriding the "
+                         "--replicas x --replicas-per-shard cross "
+                         "product — a small box can measure 1x2 and "
+                         "2x1 without the 2x2 cell's process count")
+    ap.add_argument("--kill-probe", action="store_true",
+                    help="in every R>1 cell, kill one group member "
+                         "under steady load after the ladder and "
+                         "record the kill-window availability "
+                         "fraction (non-partial 200s)")
+    ap.add_argument("--admission-max-inflight", type=int, default=0,
+                    help="arm the router's admission hard cap on "
+                         "concurrent data-plane requests (0 = off)")
+    ap.add_argument("--admission-queue-wait-ms", type=int, default=0,
+                    help="arm the router's measured-queue-wait shed "
+                         "threshold in ms (0 = off)")
+    ap.add_argument("--overload-factor", type=float, default=3.0,
+                    help="overload rung rate = this x the cell's best "
+                         "sustained qps (only when admission is "
+                         "armed)")
+    ap.add_argument("--admission-cells", default="",
+                    help="comma list of NxR cells to arm admission "
+                         "in (default: every cell when the admission "
+                         "flags are set).  An armed cell's ladder "
+                         "sheds near the ceiling, so keep the "
+                         "regression-gated baseline cells un-gated — "
+                         "exactly the configuration their previous "
+                         "rounds ran")
+    ap.add_argument("--out", default="BENCH_GATEWAY_r09.json")
     ap.add_argument("--keep-work", action="store_true")
     args = ap.parse_args(argv)
 
@@ -401,14 +677,42 @@ def main(argv: list[str] | None = None) -> int:
         publish_s = round(time.time() - t0, 1)
         print(f"== published model stream in {publish_s}s ==",
               file=sys.stderr)
-        for n in [int(x) for x in args.replicas.split(",") if x]:
-            print(f"== cell: {n} replica(s) ==", file=sys.stderr)
+        admission = {}
+        if args.admission_max_inflight > 0:
+            admission["oryx.cluster.admission.max-inflight"] = \
+                args.admission_max_inflight
+        if args.admission_queue_wait_ms > 0:
+            admission["oryx.cluster.admission.queue-wait-high-ms"] = \
+                args.admission_queue_wait_ms
+        if args.cells:
+            cells = [tuple(int(v) for v in c.split("x"))
+                     for c in args.cells.split(",") if c]
+        else:
+            group_sizes = [int(x) for x in
+                           args.replicas_per_shard.split(",") if x]
+            cells = [(n, rps)
+                     for n in [int(x) for x in
+                               args.replicas.split(",") if x]
+                     for rps in group_sizes]
+        admission_cells = {
+            tuple(int(v) for v in c.split("x"))
+            for c in args.admission_cells.split(",") if c}
+        for n, rps in cells:
+            print(f"== cell: {n} shard(s) x {rps} member(s) ==",
+                  file=sys.stderr)
+            cell_admission = admission or None
+            if admission_cells and (n, rps) not in admission_cells:
+                cell_admission = None
             row = run_cell(
                 n, args.items, args.features, args.users, rates,
                 args.duration, args.replica_threads, work_dir,
                 broker_dir=broker_dir, user_ids=user_ids,
                 device_ms_per_mrow=args.device_ms_per_mrow,
-                tracing_sample=args.tracing_sample)
+                tracing_sample=args.tracing_sample,
+                replicas_per_shard=rps,
+                kill_member_probe=args.kill_probe,
+                admission=cell_admission,
+                overload_factor=args.overload_factor)
             row["publish_s"] = publish_s
             rows.append(row)
             print(json.dumps({k: v for k, v in rows[-1].items()
@@ -417,7 +721,10 @@ def main(argv: list[str] | None = None) -> int:
         if not args.keep_work:
             shutil.rmtree(work_dir, ignore_errors=True)
 
-    by_n = {r["replicas"]: r["open_loop_sustained_qps"] for r in rows}
+    # shard-scaling summary compares like-for-like R=1 cells only;
+    # replica groups add availability, not shard-scaling
+    by_n = {r["replicas"]: r["open_loop_sustained_qps"]
+            for r in rows if r["replicas_per_shard"] == 1}
     report = {
         "metric": "gateway_recommend_scaling",
         "tracing_sample": args.tracing_sample,

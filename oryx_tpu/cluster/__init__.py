@@ -24,12 +24,25 @@ Pieces:
 - :mod:`.router` — the gateway layer: the existing public HTTP front
   end, answered by scatter-gather over the shard replicas, degrading
   to partial answers (``X-Oryx-Partial``) when shards are down.
+- :mod:`.admission` — measured-queue-wait admission control: overload
+  sheds data-plane requests as fast 503 + ``Retry-After`` instead of
+  queueing into collapse.
+- :mod:`.autoscaler` — the gauge-driven supervisor
+  (``python -m oryx_tpu autoscale``): consumes the router's own
+  signals (merged p99 buckets, queue wait, update lag) and
+  spawns/retires replica-group members under the resilience
+  Supervisor.
 
-Run a 2-shard cluster::
+Run a 2-shard cluster (R-way replica groups = start R processes per
+shard; any subset of a shard's group covers it)::
 
     python -m oryx_tpu serving --shard 0/2 --conf my.conf &
     python -m oryx_tpu serving --shard 1/2 --conf my.conf &
     python -m oryx_tpu router --conf my.conf &
 
-See docs/SCALING.md for the topology and protocol.
+Live N→M reshard (no restarts anywhere): declare the target
+(``POST /admin/topology {"of": M}``), start the M-way fleet, watch
+``GET /admin/topology`` until cutover, retire the old fleet.
+
+See docs/SCALING.md for the topology, protocol, and runbooks.
 """

@@ -193,8 +193,12 @@ def _cluster_solver(req: Request) -> tuple[object, bool, int, list[int]]:
             cands = reg.candidates(shard)
             entry = None
             if cands:
-                # heartbeat generation of the replica a query would hit
-                entry = cache.get((shard, cands[0].generation))
+                # heartbeat generation of the replica a query would
+                # hit.  Keyed by TOPOLOGY too: shard 0 of 2 and shard
+                # 0 of 3 are different catalog slices, and a live
+                # reshard must never reuse the old ring's partial
+                # Gramian under the new ring's shard number
+                entry = cache.get((n, shard, cands[0].generation))
             if entry is None:
                 missing.append(shard)
             else:
@@ -218,13 +222,19 @@ def _cluster_solver(req: Request) -> tuple[object, bool, int, list[int]]:
                 entry = (np.asarray(r.payload["yty"], dtype=np.float64),
                          bool(r.payload.get("implicit", True)),
                          int(r.payload.get("features", 0)))
-                # one entry per shard: drop older generations.  Keyed
-                # by the generation the REPLICA reports (authoritative;
-                # a heartbeat mid-swap may lag it by one — the next
-                # request re-checks against the fresher heartbeat)
-                for k in [k for k in cache if k[0] == shard]:
+                # one entry per (topology, shard): drop older
+                # generations, and drop OTHER topologies wholesale — a
+                # retired ring's partial Gramians are features² float64
+                # blocks that would otherwise pin forever across
+                # repeated reshards.  Keyed by the generation the
+                # REPLICA reports (authoritative; a heartbeat mid-swap
+                # may lag it by one — the next request re-checks
+                # against the fresher heartbeat)
+                for k in [k for k in cache
+                          if k[0] != n or k[1] == shard]:
                     del cache[k]
-                cache[(shard, int(r.payload.get("generation", 0)))] = entry
+                cache[(n, shard,
+                       int(r.payload.get("generation", 0)))] = entry
                 entries[shard] = entry
     total = None
     implicit, features = True, 0
@@ -574,6 +584,35 @@ def _ingest(req: Request):
     return serving_ingest(req)
 
 
+# -- topology admin -----------------------------------------------------------
+
+def _topology_get(req: Request):
+    """Reshard/topology status: the merged topology, the declared
+    warming target's coverage and worst warm fraction, retired
+    topologies, and the stale-heartbeat counter — the view the reshard
+    runbook watches between 'start the M-way fleet' and 'cutover
+    happened' (docs/SCALING.md)."""
+    return _reg(req).topology_status()
+
+
+def _topology_post(req: Request):
+    """Declare a reshard target: ``{"of": M}``.  New-topology replicas'
+    heartbeats are accepted from now on, and the router cuts over
+    atomically once every one of the M shards has a live ready
+    replica.  Declaring a retired topology un-retires it (scale back
+    down); declaring the merged topology cancels a pending target."""
+    try:
+        body = json.loads(req.body.decode("utf-8"))
+        of = int(body["of"])
+    except (ValueError, TypeError, KeyError) as e:
+        raise OryxServingException(
+            400, f'body must be {{"of": M}}: {e}') from e
+    try:
+        return _reg(req).begin_reshard(of)
+    except ValueError as e:
+        raise OryxServingException(400, str(e)) from e
+
+
 # -- framework ----------------------------------------------------------------
 
 def _ready(req: Request):
@@ -625,6 +664,12 @@ def _metrics(req: Request):
         },
         "resilience": resilience_snapshot(),
     }
+    admission = req.context.get("admission")
+    if admission is not None:
+        out["cluster"]["admission"] = admission.stats()
+    gauges = registry.gauges_snapshot()
+    if gauges:
+        out["freshness"] = gauges
     tracer = req.context.get("tracer")
     if tracer is not None:
         out["obs"] = {"trace_record_failures": tracer.record_failures}
@@ -637,29 +682,35 @@ def _error(req: Request):
 
 
 ROUTES = [
-    Route("GET", "/recommend/{userID}", _recommend),
-    Route("GET", "/recommendToMany/{userIDs:+}", _recommend_to_many),
+    # admission=True marks the scatter data plane: when the admission
+    # controller measures overload these shed as fast 503 + Retry-After
+    # (cluster/admission.py); health/admin/write endpoints stay open
+    Route("GET", "/recommend/{userID}", _recommend, admission=True),
+    Route("GET", "/recommendToMany/{userIDs:+}", _recommend_to_many,
+          admission=True),
     Route("GET", "/recommendToAnonymous/{itemIDs:+}",
-          _recommend_to_anonymous),
+          _recommend_to_anonymous, admission=True),
     Route("GET", "/recommendWithContext/{userID}/{itemIDs:+}",
-          _recommend_with_context),
-    Route("GET", "/similarity/{itemIDs:+}", _similarity),
+          _recommend_with_context, admission=True),
+    Route("GET", "/similarity/{itemIDs:+}", _similarity, admission=True),
     Route("GET", "/similarityToItem/{toItemID}/{itemIDs:+}",
-          _similarity_to_item),
-    Route("GET", "/estimate/{userID}/{itemIDs:+}", _estimate),
+          _similarity_to_item, admission=True),
+    Route("GET", "/estimate/{userID}/{itemIDs:+}", _estimate,
+          admission=True),
     Route("GET", "/estimateForAnonymous/{toItemID}/{itemIDs:+}",
-          _estimate_for_anonymous),
-    Route("GET", "/because/{userID}/{itemID}", _because),
-    Route("GET", "/mostSurprising/{userID}", _most_surprising),
-    Route("GET", "/mostActiveUsers", _most_counts),
-    Route("GET", "/mostPopularItems", _most_counts),
+          _estimate_for_anonymous, admission=True),
+    Route("GET", "/because/{userID}/{itemID}", _because, admission=True),
+    Route("GET", "/mostSurprising/{userID}", _most_surprising,
+          admission=True),
+    Route("GET", "/mostActiveUsers", _most_counts, admission=True),
+    Route("GET", "/mostPopularItems", _most_counts, admission=True),
     Route("GET", "/popularRepresentativeItems",
-          _popular_representative_items),
-    Route("GET", "/user/allIDs", _proxy_any),
-    Route("GET", "/allUserIDs", _proxy_any),
-    Route("GET", "/item/allIDs", _all_item_ids),
-    Route("GET", "/allItemIDs", _all_item_ids),
-    Route("GET", "/knownItems/{userID}", _proxy_any),
+          _popular_representative_items, admission=True),
+    Route("GET", "/user/allIDs", _proxy_any, admission=True),
+    Route("GET", "/allUserIDs", _proxy_any, admission=True),
+    Route("GET", "/item/allIDs", _all_item_ids, admission=True),
+    Route("GET", "/allItemIDs", _all_item_ids, admission=True),
+    Route("GET", "/knownItems/{userID}", _proxy_any, admission=True),
     Route("POST", "/pref/{userID}/{itemID}", _pref_post, mutates=True),
     Route("DELETE", "/pref/{userID}/{itemID}", _pref_delete, mutates=True),
     Route("POST", "/ingest", _ingest, mutates=True),
@@ -669,6 +720,9 @@ ROUTES = [
     # mutating: captures device state to disk — read-only mode and
     # DIGEST auth (when configured) both gate it
     Route("GET", "/admin/profile", admin_profile, mutates=True),
+    # elastic-topology admin: reshard status + target declaration
+    Route("GET", "/admin/topology", _topology_get),
+    Route("POST", "/admin/topology", _topology_post, mutates=True),
     Route("GET", "/error", _error),
     console.console_route("ALS scatter-gather gateway", [
         console.Endpoint("/recommend/{0}", ("userID",)),
@@ -723,6 +777,16 @@ class RouterLayer:
         self.scatter = ScatterGather(self.membership, config,
                                      tracer=self.tracer)
         self.metrics = MetricsRegistry()
+        # measured-queue-wait admission control (cluster/admission.py;
+        # both gates default 0 = off — the shipped router admits all)
+        from .admission import AdmissionController
+        self.admission = AdmissionController(config, self.scatter,
+                                             self.metrics)
+        # the admission signal, visible as a freshness-style gauge so
+        # the autoscaler and operators read the same number the gate
+        # uses
+        self.metrics.gauge_fn("cluster_queue_wait_ms",
+                              self.scatter.cluster_queue_wait_ms)
         self.input_producer = None
         self.input_breaker = CircuitBreaker.from_config(
             "router-input", config)
@@ -748,6 +812,8 @@ class RouterLayer:
                 "tracer": self.tracer,
                 "config": config,
                 "input_producer": self.input_producer,
+                "admission":
+                    self.admission if self.admission.enabled else None,
                 "yty_cache": {},
                 "yty_lock": threading.Lock(),
             },
@@ -772,7 +838,11 @@ class RouterLayer:
                                      from_beginning=False,
                                      stop=self._stop):
                 if km.key == KEY_HEARTBEAT:
-                    self.membership.note_message(km.message)
+                    if not self.membership.note_message(km.message):
+                        # dropped: retired fleet still announcing, or a
+                        # misconfigured i/N replica whose ring does not
+                        # exist here — countable evidence, never merged
+                        self.metrics.inc("stale_topology_heartbeats")
 
         run_with_resubscribe(tail, stop=self._stop,
                              what="router membership consumer", log=_log)

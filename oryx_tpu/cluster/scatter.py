@@ -270,6 +270,58 @@ class ScatterGather:
         self.hedges = 0
         self.shard_failures = 0
         self.partial_answers = 0
+        self.group_failovers = 0
+        # replica url -> (reported scoring queue-wait ms, seen
+        # monotonic): piggybacked on every shard envelope, the live
+        # overload signal the router's admission control reads
+        self._queue_waits: dict[str, tuple[float, float]] = {}
+        self._qw_cache: tuple[float | None, float] = (None, -1e9)
+
+    # how long a replica's reported queue wait stays a valid admission
+    # signal; past this (replica silent / not queried) it is ignored
+    QUEUE_WAIT_TTL_SEC = 10.0
+    # the aggregated signal is an envelope-rate EWMA — recomputing the
+    # shards x group walk (registry lock + rotation) on EVERY admitted
+    # request buys nothing; a short-lived cache keeps the admission
+    # gate near-zero cost on the hot path
+    QUEUE_WAIT_CACHE_SEC = 0.25
+
+    def note_queue_wait(self, url: str, ms: float) -> None:
+        with self._lock:
+            self._queue_waits[url] = (ms, time.monotonic())
+
+    def cluster_queue_wait_ms(self) -> float | None:
+        """The cluster's effective scoring queue wait: per shard the
+        MIN over its replica group (the best member routing could
+        pick), then the MAX over shards (every scatter waits for its
+        slowest shard).  None until any replica has reported."""
+        now = time.monotonic()
+        with self._lock:
+            value, at = self._qw_cache
+            if now - at <= self.QUEUE_WAIT_CACHE_SEC:
+                return value
+            # evict long-dead entries: with autoscaled members on
+            # ephemeral ports every spawn/retire cycle adds a URL, and
+            # TTL-ignoring without removal would grow the map forever
+            dead = [u for u, (_, seen) in self._queue_waits.items()
+                    if now - seen > 6 * self.QUEUE_WAIT_TTL_SEC]
+            for u in dead:
+                del self._queue_waits[u]
+            waits = dict(self._queue_waits)
+        worst, seen = 0.0, False
+        for shard in range(self.registry.shard_count):
+            best = None
+            for hb in self.registry.candidates(shard):
+                v = waits.get(hb.url)
+                if v is not None and now - v[1] <= self.QUEUE_WAIT_TTL_SEC:
+                    best = v[0] if best is None else min(best, v[0])
+            if best is not None:
+                seen = True
+                worst = max(worst, best)
+        out = worst if seen else None
+        with self._lock:
+            self._qw_cache = (out, now)
+        return out
 
     def close(self) -> None:
         self._exec.shutdown(wait=False)
@@ -359,6 +411,13 @@ class ScatterGather:
                     payload = json.loads(raw)
                 except ValueError:
                     payload = {"error": raw[:512].decode("latin-1")}
+            if isinstance(payload, dict) \
+                    and "queue_wait_ms" in payload:
+                try:
+                    self.note_queue_wait(hb.url,
+                                         float(payload["queue_wait_ms"]))
+                except (TypeError, ValueError):
+                    pass  # malformed envelope field: not load-bearing
             if status >= 500:
                 # replica answered but is unhealthy (lost its model,
                 # internal error): failover like a transport fault
@@ -482,12 +541,22 @@ class ScatterGather:
                 last = (i + 1 >= min(len(candidates), self.max_attempts))
                 res = drain(None if last else self.hedge_after_sec)
                 if res is not None:
+                    if errors:
+                        # a sibling answered after a group member
+                        # FAILED (not merely hedged): the replica-group
+                        # failover evidence — a dead member costs
+                        # latency, never coverage
+                        with self._lock:
+                            self.group_failovers += 1
                     return res
                 if not last:
                     with self._lock:
                         self.hedges += 1
             res = drain(None)
             if res is not None:
+                if errors:
+                    with self._lock:
+                        self.group_failovers += 1
                 return res
         finally:
             pass
@@ -604,7 +673,11 @@ class ScatterGather:
         return out
 
     def stats(self) -> dict:
+        qw = self.cluster_queue_wait_ms()
         with self._lock:
             return {"hedges": self.hedges,
                     "shard_failures": self.shard_failures,
-                    "partial_answers": self.partial_answers}
+                    "partial_answers": self.partial_answers,
+                    "group_failovers": self.group_failovers,
+                    "cluster_queue_wait_ms":
+                        None if qw is None else round(qw, 2)}

@@ -59,12 +59,19 @@ def _ordinal_of(manager):
     return lambda i: ordinals.get(i, _NO_ORDINAL)
 
 
-def _envelope(manager, **extra) -> dict:
+def _envelope(req: Request, manager, **extra) -> dict:
     out = {
         "shard": getattr(manager, "shard_index", 0),
         "of": getattr(manager, "shard_count", 1),
         "generation": getattr(manager, "generation", 0),
     }
+    batcher = req.context.get("top_n_batcher")
+    if batcher is not None:
+        # measured scoring queue wait, piggybacked on every internal
+        # answer: the router's admission control reads the cluster's
+        # live overload state from responses it already parses, no
+        # extra scrape round
+        out["queue_wait_ms"] = round(batcher.recent_queue_wait_ms(), 2)
     out.update(extra)
     return out
 
@@ -110,7 +117,7 @@ def _shard_recommend(req: Request):
     rows = _local_rows(req, model, manager, how_many,
                        user_vector=user_vector, exclude=exclude,
                        rescorer=rescorer)
-    return _envelope(manager, rows=rows)
+    return _envelope(req, manager, rows=rows)
 
 
 # -- POST /shard/query --------------------------------------------------------
@@ -235,7 +242,7 @@ def _shard_query(req: Request):
         fn = _KINDS[kind]
     except (ValueError, KeyError) as e:
         raise OryxServingException(400, f"bad shard query: {e}") from e
-    return _envelope(manager, **fn(req, model, manager, q))
+    return _envelope(req, manager, **fn(req, model, manager, q))
 
 
 # -- POST /shard/vectors ------------------------------------------------------
@@ -259,7 +266,7 @@ def _shard_vectors(req: Request):
             out[str(i)] = None if v is None else [float(x) for x in v]
         return out
 
-    return _envelope(manager,
+    return _envelope(req, manager,
                      users=fetch(q.get("users"), model.get_user_vector),
                      items=fetch(q.get("items"), model.get_item_vector))
 
@@ -273,7 +280,7 @@ def _shard_yty(req: Request):
     model = _als_model(req)
     manager = _manager(req)
     yty = model.Y.vtv()
-    return _envelope(manager, features=model.features,
+    return _envelope(req, manager, features=model.features,
                      implicit=bool(model.implicit),
                      yty=[[float(x) for x in row] for row in yty])
 
@@ -281,7 +288,7 @@ def _shard_yty(req: Request):
 def _shard_meta(req: Request):
     manager = _manager(req)
     model = manager.get_model()
-    out = _envelope(manager)
+    out = _envelope(req, manager)
     fraction = model.get_fraction_loaded() if model is not None else 0.0
     out.update(
         ready=model is not None

@@ -104,6 +104,21 @@ def _cmd_router(args) -> int:
     return 0
 
 
+def _cmd_autoscale(args) -> int:
+    """The gauge-driven supervisor (cluster/autoscaler.py): polls the
+    router's merged p99 buckets / measured queue wait / replica update
+    lag against oryx.cluster.autoscale.* thresholds and spawns or
+    retires supervised `serving --shard i/N` replica-group members."""
+    from ..cluster.autoscaler import run_autoscaler
+    config = _load_config(args.conf)
+    if args.router_url:
+        from ..common.config import from_dict
+        config = from_dict(
+            {"oryx.cluster.autoscale.router-url": args.router_url},
+            config)
+    return run_autoscaler(config, args.conf)
+
+
 def _topic_config(config: Config) -> list[tuple[str, str]]:
     return [
         (config.get_string("oryx.input-topic.broker"),
@@ -229,6 +244,9 @@ def main(argv: list[str] | None = None) -> int:
             ("router", _cmd_router,
              "run the cluster gateway: scatter-gather router over "
              "sharded serving replicas (see serving --shard)"),
+            ("autoscale", _cmd_autoscale,
+             "run the gauge-driven supervisor: scale replica groups "
+             "from the router's measured p99/queue-wait/lag signals"),
             ("kafka-setup", _cmd_kafka_setup, "create/check topics"),
             ("kafka-tail", _cmd_kafka_tail, "print topic traffic"),
             ("kafka-input", _cmd_kafka_input, "send lines to input topic"),
@@ -247,6 +265,10 @@ def main(argv: list[str] | None = None) -> int:
                                 "cluster replica (enables heartbeats "
                                 "+ /shard/* resources; front with "
                                 "'router')")
+        if name == "autoscale":
+            p.add_argument("--router-url", default=None,
+                           help="router base URL to poll (overrides "
+                                "oryx.cluster.autoscale.router-url)")
         if name == "kafka-tail":
             p.add_argument("--once", action="store_true",
                            help="drain current contents and exit")

@@ -87,6 +87,11 @@ class Route(NamedTuple):
     pattern: str              # e.g. "/recommend/{userID}", "/similarity/{itemID:+}"
     handler: Callable[["Request"], Any]
     mutates: bool = False     # disabled in read-only mode
+    # data-plane routes behind the admission controller (when one is in
+    # the app context): overload sheds them as fast 503 + Retry-After
+    # instead of queueing to collapse.  Control/health endpoints stay
+    # un-gated so operators can see INTO an overloaded process.
+    admission: bool = False
 
 
 class Request(NamedTuple):
@@ -189,6 +194,9 @@ class HttpApp:
         self._request_span = (f"{self.tracer.service}.request"
                               if self.tracer is not None else None)
         self.read_only = read_only
+        # optional admission controller (cluster/admission.py): gates
+        # routes marked admission=True; absent = no per-request cost
+        self.admission = context.get("admission")
         self.user_name = user_name
         self.password = password
         self.realm = "Oryx"
@@ -347,50 +355,72 @@ class HttpApp:
                 self._send_error(handler, 403, "endpoint is read-only")
                 self._drain_body(handler)
                 return
-            try:
-                length = int(handler.headers.get("Content-Length") or 0)
-            except ValueError:
-                if hasattr(handler, "_close"):
-                    handler._close = True  # framing unknown: don't reuse
-                self._send_error(handler, 400, "bad Content-Length")
-                return
-            body = handler.rfile.read(length) if length > 0 else b""
-            if handler.headers.get("Content-Encoding", "") == "gzip" and body:
-                try:
-                    body = gzip.decompress(body)
-                except (gzip.BadGzipFile, OSError, EOFError):
-                    self._send_error(handler, 400,
-                                     "Content-Encoding gzip but body is not")
+            admitted = False
+            if route.admission and self.admission is not None:
+                ok, retry_after = self.admission.try_acquire()
+                if not ok:
+                    # measured overload: degrade to a FAST 503 the
+                    # client can back off on, instead of queueing the
+                    # request into the collapse it would deepen
+                    self._send_error(
+                        handler, 503, "overloaded; retry later",
+                        headers={"Retry-After": str(retry_after)})
+                    self._drain_body(handler)
                     return
-            req = Request(method, path, m.groupdict(), query, body,
-                          dict(handler.headers), self.context,
-                          deadline=self._deadline(handler))
+                admitted = True
             try:
-                result = route.handler(req)
-            except OryxServingException as e:
-                self._send_error(handler, e.status, str(e))
-                return
-            except DeadlineExceeded as e:
-                # the request's time budget ran out while queued or in
-                # flight: shed it (the lambda 503 contract) rather than
-                # report a server fault
-                self._send_error(handler, 503, str(e))
-                return
-            except (ValueError, KeyError) as e:
-                self._send_error(handler, 400, f"bad request: {e}")
-                return
-            except Exception as e:  # noqa: BLE001 — uniform 500 error page
-                self._send_error(handler, 500, f"{type(e).__name__}: {e}")
-                return
-            self._send(handler, result, method == "HEAD",
-                       handler.headers.get("Accept", ""),
-                       "gzip" in handler.headers.get("Accept-Encoding", ""))
+                self._dispatch_route(handler, route, path, m, query,
+                                     method)
+            finally:
+                if admitted:
+                    self.admission.release()
             return
         if matched_path:
             self._send_error(handler, 405, "method not allowed")
         else:
             self._send_error(handler, 404, f"no resource at {path}")
         self._drain_body(handler)
+
+    def _dispatch_route(self, handler, route, path, m, query,
+                        method) -> None:
+        try:
+            length = int(handler.headers.get("Content-Length") or 0)
+        except ValueError:
+            if hasattr(handler, "_close"):
+                handler._close = True  # framing unknown: don't reuse
+            self._send_error(handler, 400, "bad Content-Length")
+            return
+        body = handler.rfile.read(length) if length > 0 else b""
+        if handler.headers.get("Content-Encoding", "") == "gzip" and body:
+            try:
+                body = gzip.decompress(body)
+            except (gzip.BadGzipFile, OSError, EOFError):
+                self._send_error(handler, 400,
+                                 "Content-Encoding gzip but body is not")
+                return
+        req = Request(method, path, m.groupdict(), query, body,
+                      dict(handler.headers), self.context,
+                      deadline=self._deadline(handler))
+        try:
+            result = route.handler(req)
+        except OryxServingException as e:
+            self._send_error(handler, e.status, str(e))
+            return
+        except DeadlineExceeded as e:
+            # the request's time budget ran out while queued or in
+            # flight: shed it (the lambda 503 contract) rather than
+            # report a server fault
+            self._send_error(handler, 503, str(e))
+            return
+        except (ValueError, KeyError) as e:
+            self._send_error(handler, 400, f"bad request: {e}")
+            return
+        except Exception as e:  # noqa: BLE001 — uniform 500 error page
+            self._send_error(handler, 500, f"{type(e).__name__}: {e}")
+            return
+        self._send(handler, result, method == "HEAD",
+                   handler.headers.get("Accept", ""),
+                   "gzip" in handler.headers.get("Accept-Encoding", ""))
 
     def _send(self, handler, result, head_only: bool, accept: str,
               gzip_ok: bool) -> None:
@@ -441,7 +471,8 @@ class HttpApp:
         if not head_only:
             handler.wfile.write(payload)
 
-    def _send_error(self, handler, status: int, message: str) -> None:
+    def _send_error(self, handler, status: int, message: str,
+                    headers: dict[str, str] | None = None) -> None:
         # uniform error page, HTML for browsers (reference:
         # ErrorResource.java:36, wired as the error page for every
         # status by ServingLayer.java:305-311)
@@ -452,6 +483,8 @@ class HttpApp:
         trace_id = getattr(handler, "_oryx_trace", None)
         if trace_id:
             handler.send_header("X-Oryx-Trace", trace_id)
+        for k, v in (headers or {}).items():
+            handler.send_header(k, v)
         handler.send_header("Content-Type", ctype)
         handler.send_header("Content-Length", str(len(payload)))
         handler.end_headers()

@@ -215,6 +215,18 @@ class ServingLayer:
                     f"{c}.replica-id"))
             self.heartbeat.start()
 
+    @staticmethod
+    def _replay_stall_seam(stream):
+        """Chaos seam ``reshard-warm-stall``: mode=delay stalls the
+        update replay per record — the new-topology replica that hangs
+        mid-warm during a reshard.  It never reaches ready, so the
+        router must keep serving the OLD topology exactly (the cutover
+        gate is full ready coverage).  Unarmed: one boolean check per
+        record."""
+        for km in stream:
+            faults.fire("reshard-warm-stall")
+            yield km
+
     def _consume_updates(self) -> None:
         # broker loss mid-tail resubscribes with backoff, replaying the
         # update topic from offset 0 — recovery IS the cold-start path
@@ -227,9 +239,9 @@ class ServingLayer:
         # its count compares against the topic head's raw offsets
         run_with_resubscribe(
             lambda: self.model_manager.consume(without_heartbeats(
-                self._update_tap.wrap(
+                self._replay_stall_seam(self._update_tap.wrap(
                     broker.consume(self.update_topic, from_beginning=True,
-                                   stop=self._stop)))),
+                                   stop=self._stop))))),
             stop=self._stop, what="serving update consumer", log=_log)
 
     def await_(self) -> None:

@@ -7,14 +7,14 @@
   tiers' side-door metrics server
 """
 
-from .prom import (LATENCY_BUCKETS_MS, Histogram, merge_histograms,
-                   merge_snapshots, render_prometheus,
+from .prom import (LATENCY_BUCKETS_MS, Histogram, bucket_quantile,
+                   merge_histograms, merge_snapshots, render_prometheus,
                    render_prometheus_blocks)
 from .trace import (NOOP_SPAN, Span, Tracer, format_traceparent,
                     parse_traceparent, tracer_from_config)
 
-__all__ = ["LATENCY_BUCKETS_MS", "Histogram", "merge_histograms",
-           "merge_snapshots", "render_prometheus",
+__all__ = ["LATENCY_BUCKETS_MS", "Histogram", "bucket_quantile",
+           "merge_histograms", "merge_snapshots", "render_prometheus",
            "render_prometheus_blocks", "NOOP_SPAN", "Span",
            "Tracer", "format_traceparent", "parse_traceparent",
            "tracer_from_config"]

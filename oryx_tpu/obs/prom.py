@@ -20,8 +20,8 @@ from __future__ import annotations
 from bisect import bisect_left
 from typing import Iterable, Mapping
 
-__all__ = ["LATENCY_BUCKETS_MS", "Histogram", "merge_histograms",
-           "merge_snapshots", "render_prometheus",
+__all__ = ["LATENCY_BUCKETS_MS", "Histogram", "bucket_quantile",
+           "merge_histograms", "merge_snapshots", "render_prometheus",
            "render_prometheus_blocks"]
 
 # Fixed latency bucket upper bounds (milliseconds).  Fixed — never
@@ -51,6 +51,37 @@ class Histogram:
     def snapshot(self) -> dict:
         return {"buckets": list(self.counts),
                 "sum_ms": round(self.sum_ms, 3)}
+
+
+def bucket_quantile(buckets: "Iterable[int]", q: float,
+                    bounds: "tuple[float, ...]" = LATENCY_BUCKETS_MS
+                    ) -> float | None:
+    """Estimate the q-quantile (0 < q < 1) from PER-bucket counts —
+    the standard Prometheus histogram_quantile: linear interpolation
+    inside the bucket the target rank falls in, with the +Inf overflow
+    bucket reporting its lower bound (there is nothing to interpolate
+    toward).  None on an empty histogram.  This is how the autoscaler
+    turns the cluster's exactly-merged latency buckets into the p99 it
+    compares against its thresholds — mergeable where reservoir
+    percentiles never were."""
+    counts = [int(c) for c in buckets]
+    total = sum(counts)
+    if total <= 0:
+        return None
+    rank = q * total
+    cum = 0
+    for i, c in enumerate(counts):
+        prev = cum
+        cum += c
+        if cum >= rank:
+            if i >= len(bounds):
+                return float(bounds[-1])  # +Inf bucket: lower bound
+            lo = 0.0 if i == 0 else float(bounds[i - 1])
+            hi = float(bounds[i])
+            if c <= 0:
+                return hi
+            return lo + (hi - lo) * (rank - prev) / c
+    return float(bounds[-1])
 
 
 def merge_histograms(snaps: Iterable[Mapping]) -> dict:

@@ -121,6 +121,11 @@ class TopNBatcher:
         self.total_dispatches = 0
         # deadline sheds: refused at submit or expired while queued
         self.deadline_rejects = 0
+        # measured queue wait (enqueue -> drain pickup), EWMA over
+        # recent drains: the overload signal replicas report upstream
+        # for the router's admission control (under _cond)
+        self._qwait_ewma = 0.0
+        self._qwait_at = 0.0
 
     def top_n(self, model, how_many: int, user_vector: np.ndarray,
               exclude: Iterable[str] = (),
@@ -171,12 +176,27 @@ class TopNBatcher:
             raise job.error
         return job.result
 
+    def recent_queue_wait_ms(self) -> float:
+        """The batcher's current queue-wait estimate in ms: the larger
+        of the recent-drain EWMA (decayed to 0 after 5 idle seconds)
+        and the LIVE age of the oldest still-queued job — so a queue
+        that stopped draining reports a growing wait, not the stale
+        average of better times."""
+        now = time.monotonic()
+        with self._cond:
+            ew = self._qwait_ewma if now - self._qwait_at <= 5.0 else 0.0
+            oldest = (now - self._pending[0].t_enq) if self._pending \
+                else 0.0
+        return max(ew, oldest) * 1000.0
+
     def stats(self) -> dict:
         """Live pacing/batching state for the /metrics surface."""
+        qw = self.recent_queue_wait_ms()
         with self._cond:
             sizes = self.batch_sizes[-1000:]
             return {
                 "dispatches": self.total_dispatches,
+                "queue_wait_ms": round(qw, 2),
                 "mean_recent_batch": round(sum(sizes) / len(sizes), 1)
                 if sizes else 0.0,
                 "service_time_ms": round(self._exec_ewma * 1e3, 2),
@@ -343,6 +363,18 @@ class TopNBatcher:
                     "request deadline expired while queued")
                 j.done.set()
             jobs = [j for j in jobs if j.error is None]
+        if jobs:
+            # queue wait of this drain = the oldest job's enqueue->pickup
+            # age; EWMA'd so the admission signal tracks load, not one
+            # straggler.  Sampled BEFORE the dispatch seam below: the
+            # emulated device delay is service time, and folding it into
+            # the wait would inflate the admission signal by one full
+            # dispatch even with an empty queue
+            now = time.monotonic()
+            qw = max(now - j.t_enq for j in jobs)
+            with self._cond:
+                self._qwait_ewma = 0.7 * self._qwait_ewma + 0.3 * qw
+                self._qwait_at = now
         # chaos / device-emulation seam: one fire per drained dispatch.
         # mode=delay stands in for per-dispatch device time the host
         # does not burn CPU on — bench/gateway.py stages it to model

@@ -200,11 +200,21 @@ def test_warmup_cli_reports_compiles(tmp_path):
 # -- gateway scaling regression gate (ISSUE 4 satellite) ---------------------
 
 def _gateway_doc(cells, backend="cpu"):
+    """Cells are (features, items, replicas, qps) or, since the r09
+    replica-group dimension, (features, items, replicas, R, qps)."""
+    rows = []
+    for cell in cells:
+        f, i, n, *rest = cell
+        rps, qps = (rest[0], rest[1]) if len(rest) == 2 \
+            else (None, rest[0])
+        row = {"features": f, "items": i, "replicas": n,
+               "open_loop_sustained_qps": qps,
+               "merge_spotcheck_ok": True}
+        if rps is not None:
+            row["replicas_per_shard"] = rps
+        rows.append(row)
     return {"metric": "gateway_recommend_scaling", "backend": backend,
-            "rows": [{"features": f, "items": i, "replicas": n,
-                      "open_loop_sustained_qps": qps,
-                      "merge_spotcheck_ok": True}
-                     for (f, i, n, qps) in cells]}
+            "rows": rows}
 
 
 def test_check_regression_gateway_passes_and_reports_cells(tmp_path,
@@ -238,6 +248,45 @@ def test_check_regression_gateway_fails_on_per_replica_cell_drop(
     report = json.loads(capsys.readouterr().out)
     assert [c["cell"] for c in report["regressions"]] == \
         ["50f/0.065536M/2rep"]
+
+
+def test_check_regression_gateway_replica_group_cells_gate_independently(
+        tmp_path, capsys):
+    """An R=2 replica-group cell regressing fails the gate even when
+    its R=1 sibling at the same shard count improved — and rows
+    without the field (pre-r09 artifacts) join the R=1 key."""
+    prev = _gateway_doc([(50, 65536, 2, 170.0),          # implicit R=1
+                         (50, 65536, 2, 2, 160.0)])
+    cur = _gateway_doc([(50, 65536, 2, 1, 190.0),        # explicit R=1
+                        (50, 65536, 2, 2, 120.0)])
+    rc = cr.main(["--kind", "gateway",
+                  "--previous", _write(tmp_path,
+                                       "BENCH_GATEWAY_r08.json", prev),
+                  "--current", _write(tmp_path,
+                                      "BENCH_GATEWAY_r09.json", cur)])
+    assert rc == 1
+    report = json.loads(capsys.readouterr().out)
+    assert [c["cell"] for c in report["regressions"]] == \
+        ["50f/0.065536M/2repx2"]
+    assert [c["cell"] for c in report["improved"]] == \
+        ["50f/0.065536M/2rep"]
+
+
+def test_check_regression_gateway_new_replica_group_cell_not_gated(
+        tmp_path, capsys):
+    """A first-ever R-cell has no baseline: reported as new, exit 0."""
+    prev = _gateway_doc([(50, 65536, 2, 170.0)])
+    cur = _gateway_doc([(50, 65536, 2, 1, 168.0),
+                        (50, 65536, 2, 2, 150.0)])
+    rc = cr.main(["--kind", "gateway",
+                  "--previous", _write(tmp_path,
+                                       "BENCH_GATEWAY_r08.json", prev),
+                  "--current", _write(tmp_path,
+                                      "BENCH_GATEWAY_r09.json", cur)])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["new_cells"] == ["(50, 65536, 2, 2)"]
+    assert not report["missing_cells"]
 
 
 def test_check_regression_gateway_discovers_rounds_and_skips_cross_backend(

@@ -144,7 +144,9 @@ def test_snapshot_reports_current_topology_after_reshard_down():
     for s in range(4):
         reg.note(_hb(f"r{s}", s, of=4))
     assert reg.snapshot()["shards"] == 4
-    clock.t = 2.0  # 4-way fleet stops; 2-way fleet starts
+    # 4-way fleet stops; the bootstrap hatch re-opens only once it has
+    # been silent past the re-bootstrap grace (dead, not blinking)
+    clock.t = 1.0 * MembershipRegistry.REBOOTSTRAP_GRACE_TTLS + 1.1
     reg.note(_hb("n0", 0, of=2))
     reg.note(_hb("n1", 1, of=2))
     assert reg.shard_count == 2

@@ -1,0 +1,385 @@
+"""Elastic-topology unit + property tests (ISSUE 6): the membership
+registry's topology state machine (warming → atomic cutover →
+retired), stale-heartbeat rejection, ring-filtered replay properties
+for live N→M resharding, R-way replica-group exactness, and the
+router's measured-queue-wait admission control.
+
+The reshard property tests reuse the test_cluster_merge oracle
+harness: old- and new-topology managers consume the IDENTICAL
+simulated update-topic stream (exactly how a warming replica replays
+through the murmur2 ring), and exactness claims are checked against
+both the single full-catalog node and the independent numpy oracle.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from oryx_tpu.cluster.admission import AdmissionController
+from oryx_tpu.cluster.membership import Heartbeat, MembershipRegistry
+from oryx_tpu.cluster.merge import exact_local_top_n, merge_top_n
+from oryx_tpu.common.config import from_dict
+from oryx_tpu.lambda_rt.metrics import MetricsRegistry
+from oryx_tpu.obs.prom import LATENCY_BUCKETS_MS, bucket_quantile
+from tests.test_cluster_merge import (_manager, _oracle_top_n,
+                                      _random_replay)
+
+_NO_ORD = 1 << 62
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _hb(replica, shard, of=2, gen=1, ready=True, fraction=1.0):
+    return Heartbeat(replica=replica, shard=shard, of=of,
+                     url=f"http://h{replica}:80", generation=gen,
+                     ready=ready, fraction=fraction)
+
+
+def _merged_two(clock=None) -> MembershipRegistry:
+    reg = MembershipRegistry(ttl_sec=10.0, clock=clock or _Clock())
+    reg.note(_hb("a", 0))
+    reg.note(_hb("b", 1))
+    assert reg.shard_count == 2  # bootstrap commit on full coverage
+    return reg
+
+
+# -- topology state machine ---------------------------------------------------
+
+def test_misconfigured_heartbeats_rejected_with_counter():
+    reg = _merged_two()
+    # structurally invalid shard coordinates
+    assert reg.note(_hb("bad", 5, of=2)) is False
+    assert reg.note(_hb("bad2", 0, of=0)) is False
+    # an undeclared foreign topology while the merged fleet is alive:
+    # the wrong-ring replica is dropped, counted, and never routed
+    assert reg.note(_hb("rogue", 0, of=3)) is False
+    assert reg.stale_topology_heartbeats == 3
+    assert "rogue" not in reg.snapshot()["replicas"]
+    assert reg.shard_count == 2
+
+
+def test_rogue_full_replica_cannot_yank_topology():
+    """A lone 0/1 replica is trivially 'fully covered' by itself; it
+    must not pull the routed topology down to 1 (which would serve the
+    whole catalog from one node and double-count against nothing)."""
+    reg = _merged_two()
+    assert reg.note(_hb("full", 0, of=1)) is False
+    for _ in range(3):
+        assert reg.shard_count == 2
+    assert reg.stale_topology_heartbeats == 1
+
+
+def test_declared_reshard_waits_for_full_coverage_then_cuts_over():
+    reg = _merged_two()
+    reg.begin_reshard(3)
+    # new-topology replicas warm: accepted, tracked, never routed
+    assert reg.note(_hb("n0", 0, of=3))
+    assert reg.note(_hb("n1", 1, of=3))
+    assert reg.note(_hb("n2", 2, of=3, ready=False, fraction=0.4))
+    assert reg.shard_count == 2
+    assert [h.of for h in reg.candidates(0)] == [2]
+    status = reg.topology_status()
+    assert status["merged_of"] == 2
+    assert status["reshard_target"] == 3
+    t3 = status["topologies"]["3"]
+    assert t3["state"] == "warming" and not t3["full_coverage"]
+    assert t3["ready_shards"] == 2 and t3["min_fraction"] == 0.4
+    # the moment the last warming shard turns ready: atomic cutover
+    assert reg.note(_hb("n2", 2, of=3, ready=True))
+    assert reg.shard_count == 3
+    assert all(h.of == 3 for h in reg.any_candidates())
+    assert reg.covered_shards() == [0, 1, 2]
+    assert reg.topology_cutovers == 1
+    # the old fleet is retired: purged at cutover, and its continuing
+    # heartbeats drop with the stale counter — never merged again
+    assert all(r["of"] == 3
+               for r in reg.snapshot()["replicas"].values())
+    before = reg.stale_topology_heartbeats
+    assert reg.note(_hb("a", 0, of=2)) is False
+    assert reg.stale_topology_heartbeats == before + 1
+
+
+def test_redeclaring_a_retired_topology_unretires_it():
+    reg = _merged_two()
+    reg.begin_reshard(3)
+    for s in range(3):
+        reg.note(_hb(f"n{s}", s, of=3))
+    assert reg.shard_count == 3
+    # scale back down: 2 was retired at cutover, re-declare it
+    reg.begin_reshard(2)
+    assert reg.note(_hb("m0", 0, of=2))
+    assert reg.note(_hb("m1", 1, of=2))
+    assert reg.shard_count == 2
+    assert reg.topology_cutovers == 2
+    assert all(h.of == 2 for h in reg.any_candidates())
+
+
+def test_declaring_merged_topology_cancels_target():
+    reg = _merged_two()
+    reg.begin_reshard(3)
+    assert reg.topology_status()["reshard_target"] == 3
+    reg.begin_reshard(2)
+    assert reg.topology_status()["reshard_target"] is None
+    # and the would-be warming heartbeat now drops
+    assert reg.note(_hb("n0", 0, of=3)) is False
+
+
+def test_heartbeat_blip_does_not_let_rogue_topology_take_over():
+    """A transient full-TTL gap in the merged fleet's heartbeats (a
+    broker stall, a GC pause) must NOT open the bootstrap hatch: a
+    lone 0/1 replica beating through the blip would otherwise commit
+    its ring and permanently retire the real fleet."""
+    clock = _Clock()
+    reg = MembershipRegistry(ttl_sec=1.0, clock=clock)
+    reg.note(_hb("a", 0))
+    reg.note(_hb("b", 1))
+    assert reg.shard_count == 2
+    clock.t = 1.5  # fleet past TTL, but only blinking
+    assert reg.note(_hb("rogue", 0, of=1)) is False  # inside grace
+    assert reg.shard_count == 2
+    # the fleet resumes: routed again, nothing retired, no cutover
+    reg.note(_hb("a", 0))
+    reg.note(_hb("b", 1))
+    assert reg.shard_count == 2
+    assert reg.snapshot()["replicas"]
+    assert reg.topology_cutovers == 0
+    # but a REAL total loss (past the grace) still re-bootstraps
+    clock.t = 1.5 + 1.0 * MembershipRegistry.REBOOTSTRAP_GRACE_TTLS + 1.1
+    assert reg.note(_hb("rogue2", 0, of=1)) is True
+    assert reg.shard_count == 1
+
+
+def test_total_fleet_loss_rebootstraps_without_declaration():
+    """The recovery hatch: with the merged fleet entirely gone, a
+    fresh fleet of any non-retired topology takes over once fully
+    covered — the old stop-the-world reshard still works with zero
+    admin calls."""
+    clock = _Clock()
+    reg = MembershipRegistry(ttl_sec=1.0, clock=clock)
+    reg.note(_hb("a", 0))
+    reg.note(_hb("b", 1))
+    assert reg.shard_count == 2
+    clock.t = 5.0  # old fleet gone
+    assert reg.note(_hb("n0", 0, of=3))
+    assert reg.shard_count == 2  # partial new fleet: no cutover yet
+    for s in (1, 2):
+        assert reg.note(_hb(f"n{s}", s, of=3))
+    assert reg.shard_count == 3
+
+
+def test_group_sizes_reports_merged_topology_groups():
+    reg = _merged_two()
+    reg.note(_hb("a2", 0))       # second member of shard 0's group
+    reg.note(_hb("a3", 0, ready=False))  # warming member: not counted
+    assert reg.group_sizes() == {0: 2, 1: 1}
+
+
+# -- ring-filtered replay properties (live N→M resharding) -------------------
+
+@pytest.mark.parametrize("pair", [(1, 2), (2, 3), (3, 2), (2, 5)])
+def test_reshard_replay_partitions_catalog_exactly(pair):
+    """New-topology replicas warm from the SAME totally-ordered update
+    stream, filtered through the murmur2 ring: every surviving item
+    must land on exactly one new shard and the union must be the full
+    catalog — no loss, no duplication, for any N→M."""
+    n, m = pair
+    rng = np.random.default_rng(500 + 10 * n + m)
+    old = [_manager(f"{s}/{n}") for s in range(n)]
+    new = [_manager(f"{s}/{m}") for s in range(m)]
+    full = _manager("0/1")
+    _random_replay(rng, old + new + [full])
+    surviving = sorted(full.model.all_item_ids())
+    per_new = [mm.model.all_item_ids() for mm in new]
+    assert sorted(i for ids in per_new for i in ids) == surviving
+    # ordinals (the canonical tie-break) agree across topologies
+    assert all(mm.item_ordinals == full.item_ordinals
+               for mm in old + new)
+
+
+def test_reshard_merge_exact_before_and_after_cutover():
+    """The router's answers must be byte-identical across a 2→3
+    reshard: merge(old shards) == merge(new shards) == single node ==
+    oracle, for the same user queries."""
+    rng = np.random.default_rng(77)
+    old = [_manager(f"{s}/2") for s in range(2)]
+    new = [_manager(f"{s}/3") for s in range(3)]
+    full = _manager("0/1")
+    _random_replay(rng, old + new + [full])
+    ordinals = full.item_ordinals
+    for u in range(6):
+        xu = full.model.get_user_vector(f"u{u}")
+        exclude = full.model.get_known_items(f"u{u}")
+        for how_many in (3, 12):
+            merged = {}
+            for name, fleet in (("old", old), ("new", new)):
+                per_shard = [exact_local_top_n(
+                    mm.model,
+                    lambda i, mm=mm: mm.item_ordinals.get(i, _NO_ORD),
+                    how_many, user_vector=xu, exclude=exclude)
+                    for mm in fleet]
+                merged[name] = merge_top_n(per_shard, how_many)
+            oracle = _oracle_top_n(full.model, ordinals, how_many, xu,
+                                   exclude)
+            assert merged["old"] == oracle, (u, how_many)
+            assert merged["new"] == oracle, (u, how_many)
+
+
+def test_any_two_of_three_group_members_answer_byte_identically():
+    """An R=3 replica group per shard: every member replays the same
+    stream, so ANY member's local top-k — and therefore any 2-of-3
+    surviving subset — merges byte-identically to the single
+    full-catalog node.  This is the exactness half of 'a dead replica
+    costs latency, not coverage'."""
+    rng = np.random.default_rng(91)
+    shards = 2
+    groups = [[_manager(f"{s}/{shards}") for _ in range(3)]
+              for s in range(shards)]
+    full = _manager("0/1")
+    _random_replay(rng, [m for g in groups for m in g] + [full])
+    ordinals = full.item_ordinals
+    pick = np.random.default_rng(5)
+    for u in range(6):
+        xu = full.model.get_user_vector(f"u{u}")
+        exclude = full.model.get_known_items(f"u{u}")
+        for how_many in (4, 15):
+            # each member of a group answers identically
+            for g in groups:
+                answers = [exact_local_top_n(
+                    m.model,
+                    lambda i, m=m: m.item_ordinals.get(i, _NO_ORD),
+                    how_many, user_vector=xu, exclude=exclude)
+                    for m in g]
+                assert answers[0] == answers[1] == answers[2]
+            # merge over a random surviving 2-of-3 per shard
+            per_shard = []
+            for g in groups:
+                alive = pick.choice(3, size=2, replace=False)
+                member = g[int(alive[0])]
+                per_shard.append(exact_local_top_n(
+                    member.model,
+                    lambda i, m=member: m.item_ordinals.get(i, _NO_ORD),
+                    how_many, user_vector=xu, exclude=exclude))
+            merged = merge_top_n(per_shard, how_many)
+            oracle = _oracle_top_n(full.model, ordinals, how_many, xu,
+                                   exclude)
+            assert merged == oracle, (u, how_many)
+
+
+# -- bucket quantile (the autoscaler's p99 estimator) ------------------------
+
+def test_bucket_quantile_edges_and_interpolation():
+    assert bucket_quantile([], 0.99) is None
+    assert bucket_quantile([0] * 14, 0.99) is None
+    # all mass in one bucket: interpolate within its bounds
+    counts = [0] * 14
+    counts[2] = 10  # (2, 5] ms
+    assert 2.0 < bucket_quantile(counts, 0.5) <= 5.0
+    # overflow bucket reports the top bound (nothing to interpolate to)
+    counts = [0] * 14
+    counts[-1] = 5
+    assert bucket_quantile(counts, 0.99) == LATENCY_BUCKETS_MS[-1]
+    # uniform counts: the median lands mid-range
+    q50 = bucket_quantile([7] * 14, 0.5)
+    assert LATENCY_BUCKETS_MS[5] < q50 <= LATENCY_BUCKETS_MS[7]
+
+
+# -- admission control --------------------------------------------------------
+
+class _FakeScatter:
+    def __init__(self, qw=None):
+        self.qw = qw
+
+    def cluster_queue_wait_ms(self):
+        return self.qw
+
+
+def _admission(scatter=None, metrics=None, **keys):
+    overlay = {f"oryx.cluster.admission.{k}": v for k, v in keys.items()}
+    return AdmissionController(from_dict(overlay),
+                               scatter or _FakeScatter(), metrics)
+
+
+def test_admission_disabled_by_default():
+    a = _admission()
+    assert not a.enabled
+    assert a.try_acquire() == (True, 0)
+    a.release()
+
+
+def test_admission_max_inflight_gate():
+    metrics = MetricsRegistry()
+    a = _admission(metrics=metrics, **{"max-inflight": 2,
+                                       "retry-after-sec": 3})
+    assert a.enabled
+    assert a.try_acquire() == (True, 0)
+    assert a.try_acquire() == (True, 0)
+    ok, retry_after = a.try_acquire()
+    assert not ok and retry_after == 3
+    assert a.rejected == 1
+    assert metrics.counters_snapshot()["admission_rejects"] == 1
+    a.release()
+    assert a.try_acquire() == (True, 0)
+    a.release()
+    a.release()
+    assert a.inflight == 0
+
+
+def test_admission_measured_queue_wait_gate():
+    scatter = _FakeScatter(qw=None)
+    a = _admission(scatter=scatter, **{"queue-wait-high-ms": 100})
+    # no signal yet (cluster idle / unreported): admit
+    assert a.try_acquire()[0]
+    a.release()
+    scatter.qw = 250.0
+    ok, _ = a.try_acquire()
+    assert not ok and a.inflight == 0  # rejected slot released
+    scatter.qw = 40.0
+    assert a.try_acquire()[0]
+    a.release()
+
+
+def test_admission_rejects_render_503_with_retry_after():
+    """End-to-end through the HTTP layer: an admission-marked route
+    sheds as a FAST 503 carrying Retry-After; un-marked routes (the
+    operator's view into the overloaded process) stay open."""
+    from oryx_tpu.lambda_rt.http import HttpApp, Route, make_server
+
+    a = _admission(**{"max-inflight": 1, "retry-after-sec": 7})
+    a.try_acquire()  # pin the only slot: every gated request sheds
+    app = HttpApp(
+        [Route("GET", "/data", lambda req: {"ok": True},
+               admission=True),
+         Route("GET", "/health", lambda req: {"ok": True})],
+        context={"admission": a})
+    server = make_server(app, 0)
+    port = server.server_address[1]
+    import threading
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/data", timeout=10)
+        assert e.value.code == 503
+        assert e.value.headers.get("Retry-After") == "7"
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/health", timeout=10) as r:
+            assert json.loads(r.read()) == {"ok": True}
+        # released slot: admitted again, and the handler runs
+        a.release()
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/data", timeout=10) as r:
+            assert json.loads(r.read()) == {"ok": True}
+        assert a.inflight == 0  # release() ran after the handler
+    finally:
+        server.shutdown()
