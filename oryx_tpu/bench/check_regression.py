@@ -1,7 +1,8 @@
 """CI guard: fail when the newest serving bench round regresses on
-sustained throughput (ISSUE 3 satellite; gateway cells ISSUE 4).
+sustained throughput (ISSUE 3 satellite; gateway cells ISSUE 4;
+observability overhead ISSUE 7).
 
-Two artifact families share the machinery, selected by ``--kind``:
+Three artifact families share the machinery, selected by ``--kind``:
 
 - ``grid`` (default): ``BENCH_GRID_*.json``, cells keyed by
   (features, items, lsh) — the single-node serving envelope.
@@ -10,6 +11,14 @@ Two artifact families share the machinery, selected by ``--kind``:
   scatter-gather cluster's per-topology scaling rounds (R-way
   replica-group cells gate independently of their R=1 siblings;
   pre-r09 artifacts are all R=1).
+- ``obs``: ``BENCH_OBS_OVERHEAD_*.json`` — the observability
+  hot-path microbench (bench/obs_overhead.py).  Gates on two rules:
+  a HARD absolute budget (the unsampled per-request pipeline must
+  stay under 10 µs — the standing single-digit-µs contract from
+  docs/OBSERVABILITY.md) and a relative creep gate between
+  same-backend rounds (default threshold 50% for this kind:
+  nanosecond microbenches are box-noise-sensitive where qps cells
+  are not, and the absolute budget is the real contract).
 
 Joins the two most recent rounds (by round number in the filename) on
 the cell key and exits non-zero when any cell's HEADLINE metric —
@@ -23,7 +32,7 @@ are never compared: the guard reports the skip and exits 0 — a silent
 cross-backend "regression" would train people to ignore the gate.
 
 Usage:
-    python -m oryx_tpu.bench.check_regression [--kind grid|gateway]
+    python -m oryx_tpu.bench.check_regression [--kind grid|gateway|obs]
         [--dir .] [--threshold 0.10] [--current F] [--previous F]
 Exit codes: 0 ok/skip, 1 regression, 2 usage/artifact error.
 """
@@ -36,11 +45,16 @@ import os
 import re
 import sys
 
-__all__ = ["compare_grids", "find_grid_artifacts",
-           "find_gateway_artifacts", "main"]
+__all__ = ["compare_grids", "compare_obs", "find_grid_artifacts",
+           "find_gateway_artifacts", "find_obs_artifacts", "main"]
 
 _GRID_RE = re.compile(r"BENCH_GRID(?:20M)?_r(\d+)([a-z]?)\.json$")
 _GATEWAY_RE = re.compile(r"BENCH_GATEWAY_r(\d+)([a-z]?)\.json$")
+_OBS_RE = re.compile(r"BENCH_OBS_OVERHEAD_r(\d+)([a-z]?)\.json$")
+
+# the unsampled obs pipeline's hard budget (ns/request): single-digit
+# microseconds, docs/OBSERVABILITY.md "Tracing overhead"
+OBS_BUDGET_NS = 10_000
 
 
 def _find_artifacts(directory: str, pattern: re.Pattern) -> list[str]:
@@ -60,6 +74,57 @@ def find_grid_artifacts(directory: str) -> list[str]:
 
 def find_gateway_artifacts(directory: str) -> list[str]:
     return _find_artifacts(directory, _GATEWAY_RE)
+
+
+def find_obs_artifacts(directory: str) -> list[str]:
+    return _find_artifacts(directory, _OBS_RE)
+
+
+def compare_obs(prev: dict, cur: dict, threshold: float = 0.50,
+                budget_ns: int = OBS_BUDGET_NS) -> dict:
+    """Obs-overhead comparison: the absolute per-request budget gates
+    unconditionally; the relative gate compares only keys both rounds
+    measured (r08 predates ``unsampled_full_pipeline``)."""
+    report: dict = {"regressions": [], "improved": [], "ok": [],
+                    "skipped": None, "budget_ns": budget_ns}
+    if not backends_comparable(prev.get("backend"), cur.get("backend")):
+        report["skipped"] = (
+            f"backend mismatch: previous={prev.get('backend')} "
+            f"current={cur.get('backend')} — cross-backend ns is not "
+            f"a regression signal")
+        # the absolute budget still applies to the current round
+        prev = {"microbench_ns_per_request": {}}
+    p = prev.get("microbench_ns_per_request") or {}
+    c = cur.get("microbench_ns_per_request") or {}
+    hot = c.get("unsampled_full_pipeline",
+                c.get("unsampled_begin_branch_current"))
+    if hot is None:
+        report["regressions"].append(
+            {"cell": "unsampled hot path",
+             "error": "current round measured no unsampled ns"})
+        return report
+    if hot > budget_ns:
+        report["regressions"].append(
+            {"cell": "unsampled hot path", "ns_cur": hot,
+             "over_budget_ns": budget_ns,
+             "detail": "single-digit-µs contract broken"})
+    for key in ("unsampled_begin_branch_current",
+                "unsampled_full_pipeline"):
+        if key not in p or key not in c:
+            continue
+        old, new = float(p[key]), float(c[key])
+        cell = {"cell": key, "ns_prev": old, "ns_cur": new}
+        if old <= 0:
+            report["ok"].append(cell)
+            continue
+        cell["ratio"] = round(new / old, 3)
+        if new > old * (1.0 + threshold):
+            report["regressions"].append(cell)
+        elif new < old * (1.0 - threshold):
+            report["improved"].append(cell)
+        else:
+            report["ok"].append(cell)
+    return report
 
 
 def _cells(doc: dict) -> dict:
@@ -149,18 +214,24 @@ def compare_grids(prev: dict, cur: dict,
 
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--kind", choices=("grid", "gateway"),
+    ap.add_argument("--kind", choices=("grid", "gateway", "obs"),
                     default="grid",
-                    help="artifact family: single-node serving grid or "
-                         "the cluster gateway's per-replica scaling")
+                    help="artifact family: single-node serving grid, "
+                         "the cluster gateway's per-replica scaling, "
+                         "or the observability overhead microbench")
     ap.add_argument("--dir", default=".",
                     help="directory holding BENCH_*_r*.json rounds")
-    ap.add_argument("--threshold", type=float, default=0.10)
+    ap.add_argument("--threshold", type=float, default=None,
+                    help="relative regression gate (default 0.10; "
+                         "0.50 for --kind obs, where the absolute "
+                         "budget is the real contract)")
     ap.add_argument("--current", default=None,
                     help="explicit current artifact (else newest)")
     ap.add_argument("--previous", default=None,
                     help="explicit previous artifact (else second-newest)")
     args = ap.parse_args(argv)
+    if args.threshold is None:
+        args.threshold = 0.50 if args.kind == "obs" else 0.10
 
     def _load(path):
         with open(path) as f:
@@ -175,9 +246,10 @@ def main(argv: list[str] | None = None) -> int:
             print(json.dumps({"error": f"unreadable artifact: {e}"}))
             return 2
     else:
-        arts = (find_gateway_artifacts(args.dir)
-                if args.kind == "gateway"
-                else find_grid_artifacts(args.dir))
+        finders = {"gateway": find_gateway_artifacts,
+                   "obs": find_obs_artifacts,
+                   "grid": find_grid_artifacts}
+        arts = finders[args.kind](args.dir)
         if args.current:
             cur_path = args.current
             arts = [a for a in arts
@@ -185,7 +257,8 @@ def main(argv: list[str] | None = None) -> int:
         elif arts:
             cur_path = arts.pop()
         else:
-            kind = "GATEWAY" if args.kind == "gateway" else "GRID"
+            kind = {"gateway": "GATEWAY", "obs": "OBS_OVERHEAD",
+                    "grid": "GRID"}[args.kind]
             print(json.dumps({"error": f"no BENCH_{kind}_*.json found"}))
             return 2
         try:
@@ -218,13 +291,30 @@ def main(argv: list[str] | None = None) -> int:
                     break
                 skipped_rounds.append(os.path.basename(cand))
             if prev is None:
+                if args.kind == "obs":
+                    # no relative comparison possible, but the HARD
+                    # absolute budget is unconditional — a first round
+                    # (or first round on a new backend) is exactly
+                    # where a budget break is most likely
+                    report = compare_obs(
+                        {"backend": cur.get("backend"),
+                         "microbench_ns_per_request": {}},
+                        cur, threshold=args.threshold)
+                    report["skipped"] = ("no prior obs round on "
+                                        f"backend {cur.get('backend')!r}"
+                                        " — absolute budget only")
+                    report["skipped_rounds"] = skipped_rounds
+                    report["current"] = os.path.basename(cur_path)
+                    print(json.dumps(report, indent=1))
+                    return 1 if report["regressions"] else 0
                 print(json.dumps({
-                    "skipped": "no prior grid round on backend "
+                    "skipped": f"no prior {args.kind} round on backend "
                                f"{cur.get('backend')!r}",
                     "skipped_rounds": skipped_rounds,
                     "current": os.path.basename(cur_path)}))
                 return 0
-    report = compare_grids(prev, cur, threshold=args.threshold)
+    compare = compare_obs if args.kind == "obs" else compare_grids
+    report = compare(prev, cur, threshold=args.threshold)
     report["previous"] = os.path.basename(prev_path)
     report["current"] = os.path.basename(cur_path)
     report["threshold"] = args.threshold

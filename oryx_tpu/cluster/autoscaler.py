@@ -49,23 +49,13 @@ import urllib.request
 from dataclasses import dataclass, field
 
 from ..obs.prom import LATENCY_BUCKETS_MS, bucket_quantile
+from ..obs.slo import is_data_plane as _data_plane
 from ..resilience.policy import Supervisor
 
 _log = logging.getLogger(__name__)
 
 __all__ = ["Signals", "AutoscalePolicy", "Autoscaler",
            "ReplicaLauncher", "ProcessReplicaLauncher", "run_autoscaler"]
-
-# router routes that vote on the autoscaler's p99: the public data
-# plane, not the health/metrics/admin surface this process itself hits
-_CONTROL_EXACT = frozenset({"GET /metrics", "GET /ready", "GET /error",
-                            "GET /", "unmatched"})
-_CONTROL_PREFIX = ("GET /admin",)
-
-
-def _data_plane(route: str) -> bool:
-    return route not in _CONTROL_EXACT \
-        and not route.startswith(_CONTROL_PREFIX)
 
 
 @dataclass
@@ -77,6 +67,7 @@ class Signals:
     p99_ms: float | None = None          # interval p99, data plane
     queue_wait_ms: float | None = None   # scatter's admission signal
     update_lag_records: float | None = None  # worst replica
+    slo_burn_rate: float | None = None   # router's SLO engine (obs/slo)
 
 
 @dataclass
@@ -85,6 +76,7 @@ class AutoscalePolicy:
     p99_low_ms: float = 50.0
     queue_wait_high_ms: float = 200.0
     update_lag_high_records: float = 0.0
+    slo_burn_high: float = 0.0
     scale_up_after: int = 2
     scale_down_after: int = 12
     cooldown_sec: float = 15.0
@@ -100,6 +92,7 @@ class AutoscalePolicy:
             queue_wait_high_ms=config.get_int(f"{c}.queue-wait-high-ms"),
             update_lag_high_records=config.get_int(
                 f"{c}.update-lag-high-records"),
+            slo_burn_high=config.get_double(f"{c}.slo-burn-high"),
             scale_up_after=max(1, config.get_int(f"{c}.scale-up-after")),
             scale_down_after=max(
                 1, config.get_int(f"{c}.scale-down-after")),
@@ -124,6 +117,14 @@ class AutoscalePolicy:
                 and s.update_lag_records > self.update_lag_high_records:
             out.append(f"update_lag {s.update_lag_records:.0f} > "
                        f"{self.update_lag_high_records:.0f}")
+        if self.slo_burn_high > 0 and s.slo_burn_rate is not None \
+                and s.slo_burn_rate > self.slo_burn_high:
+            # error-budget burn (obs/slo.py): capacity is added while
+            # the budget still exists, not after the SLO is blown —
+            # scaling on burn rate instead of a raw latency threshold
+            # is what ties the fleet size to the objective
+            out.append(f"slo_burn {s.slo_burn_rate:.1f} > "
+                       f"{self.slo_burn_high:.1f}")
         return out
 
     def calm(self, s: Signals) -> bool:
@@ -340,13 +341,25 @@ class Autoscaler:
         self.actions: list[dict] = []
         # previous cumulative data-plane bucket counts (interval p99)
         self._prev_buckets: list[int] | None = None
+        # counter-reset discards: a restarted process's cumulative
+        # buckets went backwards, so that interval's delta is garbage
+        self.counter_resets = 0
 
     # -- signal collection ---------------------------------------------------
 
     def _interval_p99(self, prom_snap: dict) -> float | None:
         """p99 over the polls' interval: data-plane bucket-count deltas
         against the previous poll (cumulative counters must not let
-        history vote on current load)."""
+        history vote on current load).
+
+        Monotonicity guard: cumulative counters only ever grow, so ANY
+        per-bucket decrease means a process restarted and its counters
+        reset to zero mid-interval.  Clamping each bucket at 0 (the old
+        behavior) would keep the still-positive buckets and zero the
+        reset ones — a partially-zeroed delta vector whose quantile is
+        garbage, not conservative.  The whole interval is discarded
+        (None, counted as ``autoscale_counter_resets``) and the next
+        poll measures cleanly against the post-reset baseline."""
         total = [0] * (len(LATENCY_BUCKETS_MS) + 1)
         for route, r in (prom_snap.get("routes") or {}).items():
             if not _data_plane(route):
@@ -357,7 +370,14 @@ class Autoscaler:
         prev, self._prev_buckets = self._prev_buckets, total
         if prev is None:
             return None  # first poll: no interval yet
-        delta = [max(0, c - p) for c, p in zip(total, prev)]
+        if any(c < p for c, p in zip(total, prev)):
+            self.counter_resets += 1
+            if self.metrics is not None:
+                self.metrics.inc("autoscale_counter_resets")
+            _log.warning("counter reset detected (process restart?): "
+                         "discarding this interval's p99")
+            return None
+        delta = [c - p for c, p in zip(total, prev)]
         return bucket_quantile(delta, 0.99)
 
     def poll_signals(self) -> Signals:
@@ -383,6 +403,10 @@ class Autoscaler:
         s.group_sizes = groups
         qw = (cluster.get("scatter") or {}).get("cluster_queue_wait_ms")
         s.queue_wait_ms = None if qw is None else float(qw)
+        # the router's SLO engine exports its worst fast-window burn as
+        # a freshness gauge; absent (engine disabled) = no signal
+        burn = (m.get("freshness") or {}).get("slo_burn_rate")
+        s.slo_burn_rate = None if burn is None else float(burn)
         s.p99_ms = self._interval_p99(prom)
         if self.policy.update_lag_high_records > 0:
             lag = None
@@ -414,6 +438,9 @@ class Autoscaler:
         self.metrics.set_gauge("autoscale_update_lag_records",
                                -1.0 if s.update_lag_records is None
                                else s.update_lag_records)
+        self.metrics.set_gauge("autoscale_slo_burn_rate",
+                               -1.0 if s.slo_burn_rate is None
+                               else round(s.slo_burn_rate, 2))
         self.metrics.set_gauge(
             "autoscale_members",
             sum(self.launcher.owned(s.merged_of).values())

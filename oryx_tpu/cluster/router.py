@@ -43,9 +43,11 @@ from ..kafka.inproc import InProcTopicProducer, resolve_broker
 from ..lambda_rt.http import HttpApp, Request, Route, TextResponse, \
     make_server
 from ..lambda_rt.metrics import MetricsRegistry
-from ..obs import (merge_snapshots, render_prometheus_blocks,
-                   tracer_from_config)
-from ..obs.server import (admin_profile, admin_traces,
+from ..obs import (engine_from_config, events_from_config,
+                   merge_snapshots, render_openmetrics_blocks,
+                   render_prometheus_blocks, tracer_from_config)
+from ..obs.server import (OPENMETRICS_CTYPE, admin_profile, admin_slo,
+                          admin_tail, admin_traces,
                           own_prometheus_snapshot)
 from ..ops import als_fold_in
 from ..ops.solver import SingularMatrixSolverException, get_solver
@@ -628,10 +630,13 @@ def _ready(req: Request):
 def _prometheus_metrics(req: Request, registry: MetricsRegistry,
                         fmt: str):
     """The router's non-JSON /metrics forms.  ``prometheus-json`` is
-    the router's OWN mergeable snapshot; ``prometheus`` additionally
-    scrapes every live replica's snapshot and renders the cluster-wide
-    merge — fixed-bucket histogram counts sum exactly across replicas
-    (obs/prom.py), which reservoir percentiles never could."""
+    the router's OWN mergeable snapshot; ``prometheus`` and
+    ``openmetrics`` additionally scrape every live replica's snapshot
+    and render the cluster-wide merge — fixed-bucket histogram counts
+    sum exactly across replicas (obs/prom.py), which reservoir
+    percentiles never could.  The OpenMetrics form carries each
+    bucket's exemplar through the merge (newest per bucket wins), so a
+    cluster-wide p99 bucket still names one concrete trace."""
     snap = own_prometheus_snapshot(req, registry)
     if fmt == "prometheus-json":
         return snap
@@ -645,14 +650,17 @@ def _prometheus_metrics(req: Request, registry: MetricsRegistry,
     # one exposition for both blocks: the text format allows exactly
     # one # TYPE line per metric name, so the families are emitted
     # once with router- and replica-labeled samples grouped together
-    return TextResponse(render_prometheus_blocks(
-        [(snap, {"tier": "router"}), (merged, {"tier": "replica"})]))
+    blocks = [(snap, {"tier": "router"}), (merged, {"tier": "replica"})]
+    if fmt == "openmetrics":
+        return TextResponse(render_openmetrics_blocks(blocks),
+                            content_type=OPENMETRICS_CTYPE)
+    return TextResponse(render_prometheus_blocks(blocks))
 
 
 def _metrics(req: Request):
     registry: MetricsRegistry = req.context["metrics"]
     fmt = req.q1("format", "json")
-    if fmt in ("prometheus", "prometheus-json"):
+    if fmt in ("prometheus", "prometheus-json", "openmetrics"):
         return _prometheus_metrics(req, registry, fmt)
     out = {
         "routes": registry.snapshot(),
@@ -716,7 +724,11 @@ ROUTES = [
     Route("POST", "/ingest", _ingest, mutates=True),
     Route("GET", "/ready", _ready),
     Route("GET", "/metrics", _metrics),
+    # ?join=1 merges every live replica's ring by trace id — the
+    # cluster-complete view /admin/tail consumes by default
     Route("GET", "/admin/traces", admin_traces),
+    Route("GET", "/admin/tail", admin_tail),
+    Route("GET", "/admin/slo", admin_slo),
     # mutating: captures device state to disk — read-only mode and
     # DIGEST auth (when configured) both gate it
     Route("GET", "/admin/profile", admin_profile, mutates=True),
@@ -787,6 +799,18 @@ class RouterLayer:
         # uses
         self.metrics.gauge_fn("cluster_queue_wait_ms",
                               self.scatter.cluster_queue_wait_ms)
+        # SLO burn-rate engine over the router's own exactly-mergeable
+        # bucket counters (obs/slo.py; None = disabled).  Evaluated
+        # lazily on gauge reads, alert state at /admin/slo, and the
+        # burn gauge is the autoscaler's SLO pressure signal.
+        self.slo_engine = engine_from_config(config, self.metrics)
+        if self.slo_engine is not None:
+            self.metrics.gauge_fn("slo_burn_rate",
+                                  self.slo_engine.burn_gauge)
+            self.metrics.gauge_fn("slo_error_budget_remaining",
+                                  self.slo_engine.budget_gauge)
+        # wide-event request log (obs/events.py; None = disabled)
+        self.events = events_from_config(config, "router", self.metrics)
         self.input_producer = None
         self.input_breaker = CircuitBreaker.from_config(
             "router-input", config)
@@ -814,6 +838,8 @@ class RouterLayer:
                 "input_producer": self.input_producer,
                 "admission":
                     self.admission if self.admission.enabled else None,
+                "slo": self.slo_engine,
+                "events": self.events,
                 "yty_cache": {},
                 "yty_lock": threading.Lock(),
             },
@@ -877,6 +903,8 @@ class RouterLayer:
         if self._server:
             self._server.shutdown()
         self.scatter.close()
+        if self.events is not None:
+            self.events.close()
         if self.input_producer:
             self.input_producer.close()
         for t in (self._consume_thread, self._server_thread):

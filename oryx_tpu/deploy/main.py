@@ -107,8 +107,9 @@ def _cmd_router(args) -> int:
 def _cmd_autoscale(args) -> int:
     """The gauge-driven supervisor (cluster/autoscaler.py): polls the
     router's merged p99 buckets / measured queue wait / replica update
-    lag against oryx.cluster.autoscale.* thresholds and spawns or
-    retires supervised `serving --shard i/N` replica-group members."""
+    lag / SLO error-budget burn (oryx.obs.slo.*) against
+    oryx.cluster.autoscale.* thresholds and spawns or retires
+    supervised `serving --shard i/N` replica-group members."""
     from ..cluster.autoscaler import run_autoscaler
     config = _load_config(args.conf)
     if args.router_url:
@@ -246,7 +247,8 @@ def main(argv: list[str] | None = None) -> int:
              "sharded serving replicas (see serving --shard)"),
             ("autoscale", _cmd_autoscale,
              "run the gauge-driven supervisor: scale replica groups "
-             "from the router's measured p99/queue-wait/lag signals"),
+             "from the router's measured p99/queue-wait/lag signals "
+             "and SLO burn rate"),
             ("kafka-setup", _cmd_kafka_setup, "create/check topics"),
             ("kafka-tail", _cmd_kafka_tail, "print topic traffic"),
             ("kafka-input", _cmd_kafka_input, "send lines to input topic"),

@@ -42,11 +42,14 @@ class HtmlResponse:
 
 
 class TextResponse:
-    """A handler result rendered verbatim as text/plain regardless of
-    Accept (the error page's text form — ErrorResource.errorText)."""
+    """A handler result rendered verbatim as text regardless of Accept
+    (the error page's text form — ErrorResource.errorText).  The
+    content type defaults to text/plain; the OpenMetrics exposition
+    overrides it (the scraper contract names a dedicated media type)."""
 
-    def __init__(self, text: str):
+    def __init__(self, text: str, content_type: str = "text/plain"):
         self.text = text
+        self.content_type = content_type
 
 
 def render_error_page(status: int, uri: str | None, message: str | None,
@@ -141,7 +144,7 @@ def json_or_csv(value: Any, accept: str) -> tuple[bytes, str]:
     if isinstance(value, HtmlResponse):
         return value.html.encode(), "text/html; charset=utf-8"
     if isinstance(value, TextResponse):
-        return value.text.encode(), "text/plain"
+        return value.text.encode(), value.content_type
     wants_csv = "text/csv" in accept or (
         "text/plain" in accept and "json" not in accept)
     if wants_csv:
@@ -193,6 +196,10 @@ class HttpApp:
         self.tracer = context.get("tracer")
         self._request_span = (f"{self.tracer.service}.request"
                               if self.tracer is not None else None)
+        # wide-event request log (obs/events.py): None = disabled; the
+        # common request pays one attribute check plus the should_emit
+        # comparisons when configured
+        self.events = context.get("events")
         self.read_only = read_only
         # optional admission controller (cluster/admission.py): gates
         # routes marked admission=True; absent = no per-request cost
@@ -320,14 +327,32 @@ class HttpApp:
                 # unmatched paths pool under one bucket so scanners
                 # can't grow the registry unboundedly; status 0 means
                 # the request died before any response was written
-                # (counted as an error by the registry)
+                # (counted as an error by the registry).  A sampled
+                # request's trace id rides along as the latency
+                # bucket's exemplar (obs/prom.py).
                 self.metrics.record(handler._oryx_route or "unmatched",
                                     handler._oryx_status,
-                                    time.perf_counter() - t0)
+                                    time.perf_counter() - t0,
+                                    trace_id=handler._oryx_trace)
             if span is not None and span.sampled:
                 self.tracer.end_request(span,
                                         status=handler._oryx_status,
                                         route=handler._oryx_route)
+            if self.events is not None:
+                # wide-event line AFTER end_request so the request
+                # span (and the batcher's retroactive spans, recorded
+                # before the handler returned) are in the ring; emit
+                # is internally best-effort and can never raise
+                dur_ms = (time.perf_counter() - t0) * 1000.0
+                trace_id = handler._oryx_trace
+                if self.events.should_emit(handler._oryx_status,
+                                           dur_ms,
+                                           trace_id is not None):
+                    spans = self.tracer.spans_for(trace_id) \
+                        if self.tracer is not None and trace_id else None
+                    self.events.emit(handler._oryx_route or "unmatched",
+                                     handler._oryx_status, dur_ms,
+                                     trace_id, spans)
 
     def _handle(self, handler: BaseHTTPRequestHandler) -> None:
         if not self._auth_ok(handler):

@@ -50,7 +50,8 @@ class _RouteStats:
         self.filled = False
         self.hist = Histogram()
 
-    def record(self, status: int, ms: float) -> None:
+    def record(self, status: int, ms: float,
+               trace_id: str | None = None) -> None:
         self.count += 1
         if 400 <= status < 500:
             self.client_errors += 1
@@ -64,7 +65,9 @@ class _RouteStats:
         if self.pos >= _RESERVOIR:
             self.pos = 0
             self.filled = True
-        self.hist.observe(ms)
+        # sampled requests stamp their bucket with an exemplar so the
+        # cluster-wide p99 resolves to a concrete trace (obs/prom.py)
+        self.hist.observe(ms, trace_id)
 
     def snapshot(self) -> dict:
         window = self.latencies[:self.pos] if not self.filled \
@@ -105,12 +108,13 @@ class MetricsRegistry:
         self._gauge_fns: dict[str, Callable[[], float | None]] = {}
         self._lock = threading.Lock()
 
-    def record(self, route: str, status: int, seconds: float) -> None:
+    def record(self, route: str, status: int, seconds: float,
+               trace_id: str | None = None) -> None:
         with self._lock:
             stats = self._routes.get(route)
             if stats is None:
                 stats = self._routes[route] = _RouteStats()
-            stats.record(status, seconds * 1000.0)
+            stats.record(status, seconds * 1000.0, trace_id)
 
     def inc(self, counter: str, by: int = 1) -> None:
         """Bump a named cumulative counter (e.g. the cluster gateway's
@@ -156,13 +160,17 @@ class MetricsRegistry:
             return {route: stats.snapshot()
                     for route, stats in sorted(self._routes.items())}
 
-    def prometheus_snapshot(self) -> dict:
+    def prometheus_snapshot(self, gauges: bool = True) -> dict:
         """The mergeable structured view (obs/prom.py): per-route
         counts, error classes, and latency bucket counts, plus named
-        counters and gauges."""
+        counters and gauges.  ``gauges=False`` skips gauge-fn
+        evaluation — the SLO engine reads bucket counters from inside
+        a gauge fn, and evaluating gauges there would recurse."""
         with self._lock:
             routes = {route: stats.prometheus_snapshot()
                       for route, stats in sorted(self._routes.items())}
             counters = dict(sorted(self._counters.items()))
-        return {"routes": routes, "counters": counters,
-                "gauges": self.gauges_snapshot()}
+        out = {"routes": routes, "counters": counters}
+        if gauges:
+            out["gauges"] = self.gauges_snapshot()
+        return out

@@ -22,7 +22,8 @@ from ..common.config import Config
 from ..common.lang import load_instance, logging_call
 from ..kafka import utils as kafka_utils
 from ..kafka.inproc import InProcTopicProducer, resolve_broker
-from ..obs import freshness, tracer_from_config
+from ..obs import (engine_from_config, events_from_config, freshness,
+                   tracer_from_config)
 from ..resilience import faults
 from ..resilience.policy import (CircuitBreaker, ResilientTopicProducer,
                                  Retry, run_with_resubscribe)
@@ -124,6 +125,16 @@ class ServingLayer:
                                        lambda: self._update_tap.consumed))
             self.metrics.gauge_fn("model_generation_age_sec",
                                   self._update_tap.model_age_sec)
+        # SLO burn-rate engine (obs/slo.py; None = disabled): evaluated
+        # lazily whenever the gauges are read, alert state at /admin/slo
+        self.slo_engine = engine_from_config(config, self.metrics)
+        if self.slo_engine is not None:
+            self.metrics.gauge_fn("slo_burn_rate",
+                                  self.slo_engine.burn_gauge)
+            self.metrics.gauge_fn("slo_error_budget_remaining",
+                                  self.slo_engine.budget_gauge)
+        # wide-event request log (obs/events.py; None = disabled)
+        self.events = events_from_config(config, "serving", self.metrics)
         self.app = HttpApp(
             routes,
             context={
@@ -134,6 +145,8 @@ class ServingLayer:
                 "top_n_batcher": self.top_n_batcher,
                 "metrics": self.metrics,
                 "tracer": self.tracer,
+                "slo": self.slo_engine,
+                "events": self.events,
             },
             read_only=self.read_only,
             user_name=self.user_name,
@@ -255,6 +268,8 @@ class ServingLayer:
         if self._server:
             self._server.shutdown()
         self.top_n_batcher.close()
+        if self.events is not None:
+            self.events.close()
         self.model_manager.close()
         if self.input_producer:
             self.input_producer.close()

@@ -1,20 +1,31 @@
 """End-to-end observability for the lambda runtime (docs/OBSERVABILITY.md).
 
 - ``trace``   — sampled span tracer, W3C traceparent propagation
-- ``prom``    — mergeable fixed-bucket histograms + Prometheus text
+- ``prom``    — mergeable fixed-bucket histograms + Prometheus text +
+  OpenMetrics exposition with bucket exemplars
+- ``anatomy`` — critical-path stage attribution over finished span
+  trees (the /admin/tail report)
+- ``slo``     — declarative SLOs, multi-window multi-burn-rate alerts
+  (/admin/slo, the autoscaler's SLO pressure signal)
+- ``events``  — wide-event JSONL request log, size-rotated, durable
 - ``profile`` — on-demand ``jax.profiler`` capture
 - ``server``  — shared /metrics + /admin/* resources and the headless
   tiers' side-door metrics server
 """
 
+from .events import events_from_config
 from .prom import (LATENCY_BUCKETS_MS, Histogram, bucket_quantile,
-                   merge_histograms, merge_snapshots, render_prometheus,
-                   render_prometheus_blocks)
+                   merge_histograms, merge_snapshots,
+                   render_openmetrics, render_openmetrics_blocks,
+                   render_prometheus, render_prometheus_blocks)
+from .slo import engine_from_config
 from .trace import (NOOP_SPAN, Span, Tracer, format_traceparent,
                     parse_traceparent, tracer_from_config)
 
 __all__ = ["LATENCY_BUCKETS_MS", "Histogram", "bucket_quantile",
            "merge_histograms", "merge_snapshots", "render_prometheus",
-           "render_prometheus_blocks", "NOOP_SPAN", "Span",
+           "render_prometheus_blocks", "render_openmetrics",
+           "render_openmetrics_blocks", "NOOP_SPAN", "Span",
            "Tracer", "format_traceparent", "parse_traceparent",
-           "tracer_from_config"]
+           "tracer_from_config", "engine_from_config",
+           "events_from_config"]

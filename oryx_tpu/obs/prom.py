@@ -17,12 +17,14 @@ tests/test_obs_catalog.py.
 
 from __future__ import annotations
 
+import time
 from bisect import bisect_left
 from typing import Iterable, Mapping
 
 __all__ = ["LATENCY_BUCKETS_MS", "Histogram", "bucket_quantile",
            "merge_histograms", "merge_snapshots", "render_prometheus",
-           "render_prometheus_blocks"]
+           "render_prometheus_blocks", "render_openmetrics",
+           "render_openmetrics_blocks"]
 
 # Fixed latency bucket upper bounds (milliseconds).  Fixed — never
 # per-process adaptive — because exact cross-replica merging requires
@@ -34,23 +36,46 @@ LATENCY_BUCKETS_MS = (1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0, 200.0,
 
 class Histogram:
     """Fixed-bucket latency histogram.  Not thread-safe by itself — the
-    owning MetricsRegistry serializes observes under its lock."""
+    owning MetricsRegistry serializes observes under its lock.
 
-    __slots__ = ("counts", "sum_ms")
+    A bucket increment may optionally carry an *exemplar*: the sampled
+    request's trace id (plus the observed value and a wall-clock
+    stamp), so any bucket of the cluster-wide p99 resolves to one
+    concrete trace on ``/admin/traces``.  One exemplar per bucket,
+    newest wins — the OpenMetrics contract — and the unsampled hot
+    path (``trace_id=None``, the overwhelmingly common case) pays one
+    branch and no clock read."""
+
+    __slots__ = ("counts", "sum_ms", "exemplars")
 
     def __init__(self):
         # one count per bucket plus the +Inf overflow bucket; counts are
         # PER-bucket here and cumulated only at exposition time
         self.counts = [0] * (len(LATENCY_BUCKETS_MS) + 1)
         self.sum_ms = 0.0
+        # bucket index -> (trace_id, observed_ms, unix_ts); lazily
+        # allocated so exemplar-free histograms cost nothing extra
+        self.exemplars: dict[int, tuple[str, float, float]] | None = None
 
-    def observe(self, ms: float) -> None:
-        self.counts[bisect_left(LATENCY_BUCKETS_MS, ms)] += 1
+    def observe(self, ms: float, trace_id: str | None = None) -> None:
+        i = bisect_left(LATENCY_BUCKETS_MS, ms)
+        self.counts[i] += 1
         self.sum_ms += ms
+        if trace_id is not None:
+            if self.exemplars is None:
+                self.exemplars = {}
+            self.exemplars[i] = (trace_id, ms, time.time())
 
     def snapshot(self) -> dict:
-        return {"buckets": list(self.counts),
-                "sum_ms": round(self.sum_ms, 3)}
+        out = {"buckets": list(self.counts),
+               "sum_ms": round(self.sum_ms, 3)}
+        if self.exemplars:
+            # JSON-friendly: string bucket keys, list triples — the
+            # shape that rides ?format=prometheus-json to the router
+            out["exemplars"] = {
+                str(i): [t, round(v, 3), round(ts, 3)]
+                for i, (t, v, ts) in sorted(self.exemplars.items())}
+        return out
 
 
 def bucket_quantile(buckets: "Iterable[int]", q: float,
@@ -86,14 +111,27 @@ def bucket_quantile(buckets: "Iterable[int]", q: float,
 
 def merge_histograms(snaps: Iterable[Mapping]) -> dict:
     """Sum histogram snapshots bucket-wise — the exact merge reservoir
-    percentiles cannot provide."""
+    percentiles cannot provide.  Exemplars survive the merge exactly:
+    per bucket, the exemplar with the newest wall-clock stamp wins
+    across all inputs, so the cluster-wide exposition still names a
+    live trace for every populated bucket."""
     counts = [0] * (len(LATENCY_BUCKETS_MS) + 1)
     total = 0.0
+    exemplars: dict[int, list] = {}
     for s in snaps:
         for i, c in enumerate(s.get("buckets") or ()):
             counts[i] += int(c)
         total += float(s.get("sum_ms") or 0.0)
-    return {"buckets": counts, "sum_ms": round(total, 3)}
+        for k, ex in (s.get("exemplars") or {}).items():
+            i = int(k)
+            cur = exemplars.get(i)
+            if cur is None or float(ex[2]) > float(cur[2]):
+                exemplars[i] = list(ex)
+    out = {"buckets": counts, "sum_ms": round(total, 3)}
+    if exemplars:
+        out["exemplars"] = {str(i): exemplars[i]
+                            for i in sorted(exemplars)}
+    return out
 
 
 def merge_snapshots(snaps: Iterable[Mapping]) -> dict:
@@ -155,17 +193,66 @@ def render_prometheus_blocks(
     ``# TYPE`` line per metric name and requires all of a metric's
     samples to form one contiguous group, so each family is emitted
     once across all blocks, never per block."""
+    return _render_blocks(blocks, om=False)
+
+
+# -- OpenMetrics --------------------------------------------------------------
+
+def _om_num(v) -> str:
+    """Canonical OpenMetrics float rendering (``1.0``, not ``1``)."""
+    return repr(float(v))
+
+
+def _om_exemplar(ex) -> str:
+    """`` # {trace_id="..."} value timestamp`` — the OpenMetrics
+    exemplar clause carried on a ``_bucket`` sample line."""
+    return (f' # {{trace_id="{_escape(ex[0])}"}} '
+            f"{_om_num(ex[1])} {_om_num(ex[2])}")
+
+
+def render_openmetrics(snap: Mapping,
+                       labels: dict[str, str] | None = None) -> str:
+    return render_openmetrics_blocks([(snap, labels or {})])
+
+
+def render_openmetrics_blocks(
+        blocks: list[tuple[Mapping, dict[str, str]]]) -> str:
+    """The OpenMetrics 1.0 form of the exposition
+    (``/metrics?format=openmetrics``): same sample values as the
+    Prometheus 0.0.4 text, plus what 0.0.4 cannot say — histogram
+    bucket exemplars (``# {trace_id="..."} value timestamp``) naming
+    the sampled trace that landed in each bucket, and the mandatory
+    ``# EOF`` terminator.  Family naming follows the spec: a counter's
+    ``# TYPE`` line names the family WITHOUT the ``_total`` suffix its
+    samples carry.  Like the 0.0.4 renderer, several ``(snapshot,
+    base_labels)`` blocks emit each family exactly once."""
+    return _render_blocks(blocks, om=True)
+
+
+def _render_blocks(blocks: list[tuple[Mapping, dict[str, str]]],
+                   om: bool) -> str:
+    """The one block walker both text formats render through, so they
+    can never disagree on what a snapshot contains.  ``om`` switches
+    the dialect: counter ``# TYPE`` lines without the ``_total``
+    suffix, canonical-float ``le`` labels, bucket exemplars, and the
+    ``# EOF`` terminator."""
+    num = _om_num if om else _num
     out: list[str] = []
+
+    def counter_type(family: str) -> str:
+        return f"# TYPE {family} counter" if om \
+            else f"# TYPE {family}_total counter"
+
     with_routes = [(snap.get("routes") or {}, dict(base))
                    for snap, base in blocks if snap.get("routes")]
     if with_routes:
-        out.append("# TYPE oryx_requests_total counter")
+        out.append(counter_type("oryx_requests"))
         for routes, base in with_routes:
             for route, r in routes.items():
                 out.append("oryx_requests_total"
                            + _labels({**base, "route": route})
                            + f" {int(r.get('count') or 0)}")
-        out.append("# TYPE oryx_request_errors_total counter")
+        out.append(counter_type("oryx_request_errors"))
         for routes, base in with_routes:
             for route, r in routes.items():
                 for cls, key in (("client", "client_errors"),
@@ -179,20 +266,22 @@ def render_prometheus_blocks(
             for route, r in routes.items():
                 hist = r.get("latency_ms") or {}
                 counts = hist.get("buckets") or []
+                exemplars = hist.get("exemplars") or {} if om else {}
                 cum = 0
-                for bound, c in zip(LATENCY_BUCKETS_MS, counts):
-                    cum += int(c)
-                    out.append("oryx_request_latency_ms_bucket"
-                               + _labels({**base, "route": route,
-                                          "le": _num(bound)})
-                               + f" {cum}")
-                cum += int(counts[-1]) if counts else 0
-                out.append("oryx_request_latency_ms_bucket"
-                           + _labels({**base, "route": route,
-                                      "le": "+Inf"}) + f" {cum}")
+                for i in range(len(LATENCY_BUCKETS_MS) + 1):
+                    le = "+Inf" if i >= len(LATENCY_BUCKETS_MS) \
+                        else num(LATENCY_BUCKETS_MS[i])
+                    cum += int(counts[i]) if i < len(counts) else 0
+                    line = ("oryx_request_latency_ms_bucket"
+                            + _labels({**base, "route": route,
+                                       "le": le}) + f" {cum}")
+                    ex = exemplars.get(str(i))
+                    if ex:
+                        line += _om_exemplar(ex)
+                    out.append(line)
                 out.append("oryx_request_latency_ms_sum"
                            + _labels({**base, "route": route})
-                           + f" {_num(hist.get('sum_ms') or 0.0)}")
+                           + f" {num(hist.get('sum_ms') or 0.0)}")
                 out.append("oryx_request_latency_ms_count"
                            + _labels({**base, "route": route})
                            + f" {cum}")
@@ -208,12 +297,15 @@ def render_prometheus_blocks(
                 v = (snap.get(kind) or {}).get(name)
                 if v is None:
                     continue
-                v = int(v) if kind == "counters" else _num(v)
+                v = int(v) if kind == "counters" else num(v)
                 samples.append(f"oryx_{name}{suffix}"
                                f"{_labels(dict(base))} {v}")
             if samples:
-                out.append(f"# TYPE oryx_{name}{suffix} "
-                           + ("counter" if kind == "counters"
-                              else "gauge"))
+                out.append(counter_type(f"oryx_{name}")
+                           if kind == "counters"
+                           else f"# TYPE oryx_{name} gauge")
                 out.extend(samples)
+    if om:
+        out.append("# EOF")
+        return "\n".join(out) + "\n"
     return "\n".join(out) + "\n" if out else ""

@@ -25,14 +25,20 @@ import logging
 from ..api.serving import OryxServingException
 from ..lambda_rt.http import (HttpApp, Request, Route, TextResponse,
                               make_server)
+from . import anatomy
 from . import profile as profile_mod
-from .prom import render_prometheus
+from .prom import render_openmetrics, render_prometheus
 
 _log = logging.getLogger(__name__)
 
-__all__ = ["admin_traces", "admin_profile", "registry_metrics",
-           "own_prometheus_snapshot", "prometheus_response",
-           "ObsServer"]
+__all__ = ["admin_traces", "admin_tail", "admin_slo", "admin_profile",
+           "registry_metrics", "own_prometheus_snapshot",
+           "prometheus_response", "gather_traces", "ObsServer",
+           "OPENMETRICS_CTYPE"]
+
+# the OpenMetrics media type a conforming scraper negotiates for
+OPENMETRICS_CTYPE = ("application/openmetrics-text; version=1.0.0; "
+                     "charset=utf-8")
 
 
 def own_prometheus_snapshot(req: Request, registry) -> dict:
@@ -49,13 +55,18 @@ def own_prometheus_snapshot(req: Request, registry) -> dict:
 
 def prometheus_response(req: Request, registry):
     """The non-JSON ``/metrics`` forms shared by every tier, or None
-    when the request wants the tier's own JSON view."""
+    when the request wants the tier's own JSON view.
+    ``format=openmetrics`` is the exemplar-carrying exposition
+    (``# EOF`` terminated); ``prometheus`` stays the 0.0.4 text."""
     fmt = req.q1("format", "json")
-    if fmt not in ("prometheus", "prometheus-json"):
+    if fmt not in ("prometheus", "prometheus-json", "openmetrics"):
         return None
     snap = own_prometheus_snapshot(req, registry)
     if fmt == "prometheus-json":
         return snap
+    if fmt == "openmetrics":
+        return TextResponse(render_openmetrics(snap),
+                            content_type=OPENMETRICS_CTYPE)
     return TextResponse(render_prometheus(snap))
 
 
@@ -79,18 +90,94 @@ def registry_metrics(req: Request):
     return out
 
 
+# joined-ring payload caps: a cluster-complete trace dump must not
+# grow without bound with replica count
+_JOIN_MAX_TRACES_FACTOR = 4
+_JOIN_MAX_SPANS_PER_TRACE = 2048
+
+
+def gather_traces(req: Request, tracer, limit: int,
+                  join: bool) -> tuple[dict, int | None]:
+    """This process's trace ring, optionally joined (``join=1``) with
+    every live replica's ring via the scatter registry — router only;
+    on a tier without a scatter path ``join`` is a no-op.  Returns
+    ``(traces, replicas_joined)`` where the payload is capped at
+    ``4 x limit`` traces and 2048 spans per trace."""
+    traces = {tid: list(spans) for tid, spans
+              in tracer.traces_snapshot(limit=limit).items()}
+    sg = req.context.get("scatter")
+    if not join or sg is None:
+        return traces, None
+    scraped = 0
+    for _, payload in sg.scrape_replicas(
+            f"/admin/traces?limit={limit}", deadline=req.deadline):
+        scraped += 1
+        for tid, spans in (payload.get("traces") or {}).items():
+            if tid not in traces \
+                    and len(traces) >= _JOIN_MAX_TRACES_FACTOR * limit:
+                continue
+            merged = traces.setdefault(tid, [])
+            room = _JOIN_MAX_SPANS_PER_TRACE - len(merged)
+            if room > 0:
+                merged.extend(spans[:room])
+    return traces, scraped
+
+
+def _wants_join(req: Request, default: str) -> bool:
+    return req.q1("join", default) not in ("0", "false", "")
+
+
 def admin_traces(req: Request):
     """Finished traces from this process's bounded ring; a span tree is
-    reassembled client-side from parent ids, joining the rings of
-    router, replicas, and speed tier by trace id."""
+    reassembled client-side from parent ids.  On the router,
+    ``?join=1`` scrapes every live replica's ring and merges by trace
+    id, so one call returns the cluster-complete tree."""
     tracer = req.context.get("tracer")
     if tracer is None:
         raise OryxServingException(
             404, "tracing not enabled (oryx.obs.tracing.enabled)")
-    return {"service": tracer.service,
-            "record_failures": tracer.record_failures,
-            "traces": tracer.traces_snapshot(
-                limit=req.q_int("limit", 64))}
+    limit = req.q_int("limit", 64)
+    traces, joined = gather_traces(req, tracer, limit,
+                                   _wants_join(req, "0"))
+    out = {"service": tracer.service,
+           "record_failures": tracer.record_failures,
+           "traces": traces}
+    if joined is not None:
+        out["joined_replicas"] = joined
+    return out
+
+
+def admin_tail(req: Request):
+    """Tail anatomy (obs/anatomy.py): per-stage histograms, the share
+    of p99 mass each stage owns, and the top-k slowest traces with
+    stage breakdowns.  On the router the report joins replica rings by
+    default (``?join=0`` to restrict to the local ring) so the
+    serving-side stages are attributed, not lumped into scatter
+    wait."""
+    tracer = req.context.get("tracer")
+    if tracer is None:
+        raise OryxServingException(
+            404, "tracing not enabled (oryx.obs.tracing.enabled)")
+    limit = req.q_int("limit", 256)
+    traces, joined = gather_traces(req, tracer, limit,
+                                   _wants_join(req, "1"))
+    report = anatomy.tail_report(traces, top_k=req.q_int("k", 10),
+                                 route_prefix=req.q1("route"))
+    report["service"] = tracer.service
+    if joined is not None:
+        report["joined_replicas"] = joined
+    return report
+
+
+def admin_slo(req: Request):
+    """The SLO burn-rate engine's alert surface (obs/slo.py): per
+    objective, the four window burns, the alert state machine, and
+    budget remaining."""
+    engine = req.context.get("slo")
+    if engine is None:
+        raise OryxServingException(
+            404, "SLO engine not enabled (oryx.obs.slo.enabled)")
+    return engine.status()
 
 
 def admin_profile(req: Request):
@@ -111,6 +198,8 @@ def admin_profile(req: Request):
 OBS_ROUTES = [
     Route("GET", "/metrics", registry_metrics),
     Route("GET", "/admin/traces", admin_traces),
+    Route("GET", "/admin/tail", admin_tail),
+    Route("GET", "/admin/slo", admin_slo),
     # mutating: captures device state to disk — read-only mode and
     # DIGEST auth (when configured) both gate it
     Route("GET", "/admin/profile", admin_profile, mutates=True),

@@ -323,6 +323,13 @@ class Tracer:
 
     # -- read side -----------------------------------------------------------
 
+    def spans_for(self, trace_id: str) -> list[dict]:
+        """The finished spans of one trace from this process's ring
+        (empty when unknown/evicted) — the wide-event log reads the
+        just-finished request's spans through this."""
+        with self._lock:
+            return list(self._traces.get(trace_id) or ())
+
     def traces_snapshot(self, limit: int = 64) -> dict:
         """Newest ``limit`` finished traces, each a flat span list the
         caller reassembles into a tree via parent_id."""

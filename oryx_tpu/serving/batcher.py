@@ -363,6 +363,7 @@ class TopNBatcher:
                     "request deadline expired while queued")
                 j.done.set()
             jobs = [j for j in jobs if j.error is None]
+        t_pickup = time.monotonic()
         if jobs:
             # queue wait of this drain = the oldest job's enqueue->pickup
             # age; EWMA'd so the admission signal tracks load, not one
@@ -370,11 +371,10 @@ class TopNBatcher:
             # emulated device delay is service time, and folding it into
             # the wait would inflate the admission signal by one full
             # dispatch even with an empty queue
-            now = time.monotonic()
-            qw = max(now - j.t_enq for j in jobs)
+            qw = max(t_pickup - j.t_enq for j in jobs)
             with self._cond:
                 self._qwait_ewma = 0.7 * self._qwait_ewma + 0.3 * qw
-                self._qwait_at = now
+                self._qwait_at = t_pickup
         # chaos / device-emulation seam: one fire per drained dispatch.
         # mode=delay stands in for per-dispatch device time the host
         # does not burn CPU on — bench/gateway.py stages it to model
@@ -391,9 +391,17 @@ class TopNBatcher:
         by_model: dict[int, list[_Job]] = {}
         for j in jobs:
             by_model.setdefault(id(j.model), []).append(j)
+        # the device window opens at drain PICKUP (before the emulation
+        # seam): like the admission EWMA above, the emulated device
+        # delay is service time, so the recorded queue_wait/
+        # device_execute split must put it on the device side — tail
+        # attribution (obs/anatomy.py) otherwise blames the queue for
+        # a slow device.  Groups after the first open at the previous
+        # group's completion.
+        next_exec_start = t_pickup
         for group in by_model.values():
             model = group[0].model
-            t_exec = time.monotonic()
+            t_exec = next_exec_start
             status = "ok"
             try:
                 results = model.top_n_batch(
@@ -406,8 +414,9 @@ class TopNBatcher:
                 status = "error"
                 for j in group:
                     j.error = e
+            next_exec_start = time.monotonic()
             if self._tracer is not None:
-                self._record_spans(group, t_exec, time.monotonic(),
+                self._record_spans(group, t_exec, next_exec_start,
                                    status)
             with self._cond:
                 # under the lock: up to `pipeline` dispatcher threads

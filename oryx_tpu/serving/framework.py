@@ -16,7 +16,8 @@ from typing import Any
 from ..api.serving import OryxServingException
 from ..lambda_rt.http import (HtmlResponse, Request, Route, TextResponse,
                               render_error_page)
-from ..obs.server import admin_profile, admin_traces, prometheus_response
+from ..obs.server import (admin_profile, admin_slo, admin_tail,
+                          admin_traces, prometheus_response)
 from ..resilience.policy import CircuitOpenError, resilience_snapshot
 
 __all__ = ["ROUTES", "get_serving_model", "send_input"]
@@ -163,6 +164,10 @@ ROUTES = [
     Route("GET", "/error", _error),
     Route("GET", "/metrics", _metrics),
     Route("GET", "/admin/traces", admin_traces),
+    # tail anatomy + SLO alert surface (obs/anatomy.py, obs/slo.py);
+    # both 404 until their config gates open
+    Route("GET", "/admin/tail", admin_tail),
+    Route("GET", "/admin/slo", admin_slo),
     # mutating: captures device state to disk — read-only mode and
     # DIGEST auth (when configured) both gate it
     Route("GET", "/admin/profile", admin_profile, mutates=True),

@@ -222,6 +222,89 @@ def test_poll_signals_parses_router_metrics():
     assert s.p99_ms is None  # first poll has no interval
 
 
+def test_counter_reset_discards_interval_and_counts():
+    """ISSUE 7 satellite: a replica/router restart resets cumulative
+    bucket counters to 0 mid-poll.  Clamping per-bucket deltas at 0
+    (the old behavior) produced a PARTIALLY-zeroed delta vector whose
+    quantile was garbage — the whole interval must be discarded, the
+    reset counted, and the next interval measured cleanly against the
+    post-reset baseline."""
+    metrics = MetricsRegistry()
+    sc = _scaler(metrics=metrics)
+
+    def snap(counts):
+        return {"routes": {"GET /recommend/{userID}":
+                           {"latency_ms": {"buckets": list(counts)}}}}
+
+    healthy = [0] * 14
+    healthy[1] = 500          # long fast history in (1, 2] ms
+    healthy[10] = 40          # plus some old slow ones (1000, 2000]
+    assert sc._interval_p99(snap(healthy)) is None   # first poll
+    # the fake replica restarts: counters reset, then 10 fast requests
+    # land before the next poll.  Under max(0, c-p) clamping the fast
+    # bucket would delta to 0 while nothing else moved -> the old code
+    # returned a garbage quantile of an all-zero-except-noise vector;
+    # now the monotonicity violation discards the poll.
+    restarted = [0] * 14
+    restarted[1] = 10
+    assert sc._interval_p99(snap(restarted)) is None
+    assert sc.counter_resets == 1
+    assert metrics.counters_snapshot()["autoscale_counter_resets"] == 1
+    # next poll measures cleanly against the post-reset baseline
+    after = list(restarted)
+    after[1] += 100
+    p99 = sc._interval_p99(snap(after))
+    assert p99 is not None and p99 <= LATENCY_BUCKETS_MS[1]
+    assert sc.counter_resets == 1
+
+
+def test_slo_burn_pressure_signal_and_gauge():
+    """The PR 6 autoscaler scales on raw thresholds; ISSUE 7 wires the
+    SLO engine's error-budget burn in as an additional scale-up
+    signal (oryx.cluster.autoscale.slo-burn-high)."""
+    launcher = FakeLauncher()
+    metrics = MetricsRegistry()
+    sc = _scaler(_policy(slo_burn_high=10.0, p99_high_ms=0,
+                         queue_wait_high_ms=0), launcher, metrics)
+    s = _sig()
+    s.slo_burn_rate = 25.0
+    assert sc.step(s, now=0.0) is None
+    action = sc.step(s, now=1.0)
+    assert action is not None and "slo_burn 25.0 > 10.0" in action["reason"]
+    assert metrics.gauges_snapshot()["autoscale_slo_burn_rate"] == 25.0
+    # disabled (the default): the signal never votes
+    sc2 = _scaler(_policy(slo_burn_high=0.0, p99_high_ms=0,
+                          queue_wait_high_ms=0))
+    s2 = _sig()
+    s2.slo_burn_rate = 1e9
+    assert sc2.policy.pressure(s2) == []
+
+
+def test_poll_signals_reads_slo_gauge():
+    payloads = {
+        "http://r/metrics": {
+            "cluster": {"membership": {"shards": 1, "replicas": {}},
+                        "scatter": {}},
+            "freshness": {"slo_burn_rate": 18.5}},
+        "http://r/metrics?format=prometheus-json": {"routes": {}},
+    }
+    sc = Autoscaler(_policy(), FakeLauncher(), "http://r",
+                    fetch=lambda url, timeout=5.0: payloads[url])
+    assert sc.poll_signals().slo_burn_rate == 18.5
+    # engine off -> gauge absent -> None, never 0.0 (absence of
+    # evidence must not read as calm)
+    del payloads["http://r/metrics"]["freshness"]
+    assert sc.poll_signals().slo_burn_rate is None
+
+
+def test_policy_from_config_reads_slo_burn_high():
+    policy = AutoscalePolicy.from_config(from_dict({
+        "oryx.cluster.autoscale.slo-burn-high": 14.4}))
+    assert policy.slo_burn_high == 14.4
+    assert AutoscalePolicy.from_config(
+        from_dict({})).slo_burn_high == 0.0  # default: off
+
+
 def test_poll_signals_survives_unreachable_router():
     def boom(url, timeout=5.0):
         raise OSError("connection refused")

@@ -306,3 +306,90 @@ def test_check_regression_gateway_discovers_rounds_and_skips_cross_backend(
     _write(tmp_path, "BENCH_GATEWAY_r09.json",
            _gateway_doc([(50, 65536, 2, 1.0)], backend="tpu"))
     assert cr.main(["--kind", "gateway", "--dir", str(tmp_path)]) == 0
+
+
+# -- --kind obs: the observability overhead gate (ISSUE 7) --------------------
+
+def _obs_doc(unsampled_ns, full_ns=None, backend="cpu"):
+    micro = {"unsampled_begin_branch_current": unsampled_ns,
+             "sampled_begin_record_end": unsampled_ns * 6}
+    if full_ns is not None:
+        micro["unsampled_full_pipeline"] = full_ns
+    return {"metric": "obs_tracing_overhead", "backend": backend,
+            "microbench_ns_per_request": micro}
+
+
+def test_check_regression_obs_passes_within_budget(tmp_path, capsys):
+    rc = cr.main(["--kind", "obs",
+                  "--previous", _write(tmp_path,
+                                       "BENCH_OBS_OVERHEAD_r08.json",
+                                       _obs_doc(2738)),
+                  "--current", _write(tmp_path,
+                                      "BENCH_OBS_OVERHEAD_r10.json",
+                                      _obs_doc(2900, full_ns=3500))])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out)
+    assert not report["regressions"]
+    assert report["budget_ns"] == 10_000
+
+
+def test_check_regression_obs_hard_budget_gates(tmp_path, capsys):
+    # even a round that "improved" relative to a terrible previous
+    # round fails when the absolute single-digit-us budget is broken
+    rc = cr.main(["--kind", "obs",
+                  "--previous", _write(tmp_path,
+                                       "BENCH_OBS_OVERHEAD_r09.json",
+                                       _obs_doc(50_000, full_ns=60_000)),
+                  "--current", _write(tmp_path,
+                                      "BENCH_OBS_OVERHEAD_r10.json",
+                                      _obs_doc(9_000, full_ns=12_000))])
+    assert rc == 1
+    report = json.loads(capsys.readouterr().out)
+    assert any(c.get("over_budget_ns") == 10_000
+               for c in report["regressions"])
+
+
+def test_check_regression_obs_relative_creep_gates(tmp_path, capsys):
+    rc = cr.main(["--kind", "obs",
+                  "--previous", _write(tmp_path,
+                                       "BENCH_OBS_OVERHEAD_r08.json",
+                                       _obs_doc(2000)),
+                  "--current", _write(tmp_path,
+                                      "BENCH_OBS_OVERHEAD_r10.json",
+                                      _obs_doc(4000, full_ns=5000))])
+    assert rc == 1   # 2x creep > the 50% obs threshold
+    report = json.loads(capsys.readouterr().out)
+    assert report["threshold"] == 0.5
+    assert any(c["cell"] == "unsampled_begin_branch_current"
+               for c in report["regressions"])
+
+
+def test_check_regression_obs_discovers_rounds(tmp_path, capsys):
+    _write(tmp_path, "BENCH_OBS_OVERHEAD_r08.json", _obs_doc(2738))
+    _write(tmp_path, "BENCH_OBS_OVERHEAD_r10.json",
+           _obs_doc(2800, full_ns=3100))
+    # sibling families in the same dir must not be picked up
+    _write(tmp_path, "BENCH_GRID_r09.json", _grid_doc([]))
+    rc = cr.main(["--kind", "obs", "--dir", str(tmp_path)])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["previous"] == "BENCH_OBS_OVERHEAD_r08.json"
+    assert report["current"] == "BENCH_OBS_OVERHEAD_r10.json"
+
+
+def test_check_regression_obs_budget_gates_even_without_prior_round(
+        tmp_path, capsys):
+    # first-ever round (or first on a new backend): no relative
+    # comparison exists, but the absolute budget must still gate
+    _write(tmp_path, "BENCH_OBS_OVERHEAD_r10.json",
+           _obs_doc(9_000, full_ns=12_000))
+    rc = cr.main(["--kind", "obs", "--dir", str(tmp_path)])
+    assert rc == 1
+    report = json.loads(capsys.readouterr().out)
+    assert "absolute budget only" in report["skipped"]
+    assert any(c.get("over_budget_ns") == 10_000
+               for c in report["regressions"])
+    # ... and a within-budget first round passes
+    _write(tmp_path, "BENCH_OBS_OVERHEAD_r10.json",
+           _obs_doc(2_000, full_ns=3_000))
+    assert cr.main(["--kind", "obs", "--dir", str(tmp_path)]) == 0

@@ -19,7 +19,8 @@ from oryx_tpu.kafka.inproc import InProcBroker
 from oryx_tpu.lambda_rt.metrics import MetricsRegistry, _RESERVOIR
 from oryx_tpu.obs import freshness
 from oryx_tpu.obs.prom import (LATENCY_BUCKETS_MS, Histogram,
-                               merge_histograms, merge_snapshots,
+                               bucket_quantile, merge_histograms,
+                               merge_snapshots, render_openmetrics,
                                render_prometheus)
 from oryx_tpu.obs.trace import (NOOP_SPAN, Tracer, format_traceparent,
                                 parse_traceparent)
@@ -440,6 +441,201 @@ def test_file_broker_headers_persist_and_old_logs_read_back(tmp_path):
         assert got[1].headers == {"ts": "9"}
     finally:
         b2.close()
+
+
+# -- exemplars (ISSUE 7 tentpole) --------------------------------------------
+
+def test_histogram_exemplar_newest_wins_and_unsampled_costs_nothing():
+    h = Histogram()
+    h.observe(3.0)                      # unsampled: no exemplar dict
+    assert h.exemplars is None
+    h.observe(3.0, trace_id="aa" * 16)
+    h.observe(3.5, trace_id="bb" * 16)  # same bucket: newest wins
+    h.observe(30.0, trace_id="cc" * 16)
+    snap = h.snapshot()
+    i_3ms = 2       # (2, 5] ms bucket
+    i_30ms = 5      # (20, 50] ms bucket
+    assert snap["exemplars"][str(i_3ms)][0] == "bb" * 16
+    assert snap["exemplars"][str(i_3ms)][1] == pytest.approx(3.5)
+    assert snap["exemplars"][str(i_30ms)][0] == "cc" * 16
+    # exemplar presence never perturbs the counts
+    assert sum(snap["buckets"]) == 4
+
+
+def test_merge_preserves_exemplars_newest_per_bucket():
+    a, b = Histogram(), Histogram()
+    a.observe(3.0, trace_id="aa" * 16)
+    b.observe(3.0, trace_id="bb" * 16)
+    b.observe(700.0, trace_id="dd" * 16)
+    snap_a, snap_b = a.snapshot(), b.snapshot()
+    # pin the wall-clock stamps (two in-test observes can land on the
+    # same millisecond): b's exemplar is the newer one
+    snap_a["exemplars"]["2"][2] = 1000.0
+    snap_b["exemplars"]["2"][2] = 1000.5
+    merged = merge_histograms([snap_a, snap_b])
+    assert merged["exemplars"]["2"][0] == "bb" * 16
+    assert merged["exemplars"]["9"][0] == "dd" * 16
+    # order of inputs must not matter — newest TS wins, not last write
+    assert merge_histograms([snap_b, snap_a])["exemplars"]["2"][0] \
+        == "bb" * 16
+    # and the counts merged exactly as before
+    assert merged["buckets"][2] == 2
+    # an exemplar-free merge has no exemplars key at all
+    assert "exemplars" not in merge_histograms(
+        [Histogram().snapshot(), Histogram().snapshot()])
+
+
+def test_registry_record_threads_trace_id_into_exemplar():
+    reg = MetricsRegistry()
+    reg.record("GET /r", 200, 0.003, trace_id="ab" * 16)
+    reg.record("GET /r", 200, 0.004)                # unsampled
+    hist = reg.prometheus_snapshot()["routes"]["GET /r"]["latency_ms"]
+    assert hist["exemplars"]["2"][0] == "ab" * 16
+    # merge_snapshots keeps them (rides the router's cross-replica merge)
+    merged = merge_snapshots([reg.prometheus_snapshot()])
+    assert merged["routes"]["GET /r"]["latency_ms"]["exemplars"][
+        "2"][0] == "ab" * 16
+
+
+# -- OpenMetrics golden (in-test parser round-trips exemplars) ----------------
+
+_OM_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(\{(?P<labels>.*?)\})? (?P<value>\S+)"
+    r"(?: # \{(?P<exlabels>[^}]*)\} (?P<exvalue>\S+) (?P<exts>\S+))?$")
+
+
+def _parse_openmetrics(text: str):
+    """Tiny OpenMetrics parser: asserts the framing rules (one # EOF
+    at the very end, counter TYPE lines without _total) and returns
+    [(name, labels, value, exemplar|None)]."""
+    lines = text.splitlines()
+    assert lines[-1] == "# EOF", "exposition must end with # EOF"
+    assert lines.count("# EOF") == 1
+    out, types = [], {}
+    for line in lines[:-1]:
+        if line.startswith("# TYPE"):
+            _, _, family, type_ = line.split()
+            assert family not in types, f"duplicate TYPE for {family}"
+            types[family] = type_
+            if type_ == "counter":
+                assert not family.endswith("_total"), \
+                    "counter families are named without the _total suffix"
+            continue
+        if not line or line.startswith("#"):
+            continue
+        m = _OM_SAMPLE_RE.match(line)
+        assert m, f"unparseable OpenMetrics line: {line!r}"
+        labels = dict(re.findall(
+            r'([a-zA-Z_][a-zA-Z0-9_]*)="([^"]*)"', m.group("labels") or ""))
+        exemplar = None
+        if m.group("exlabels"):
+            exlabels = dict(re.findall(
+                r'([a-zA-Z_][a-zA-Z0-9_]*)="([^"]*)"', m.group("exlabels")))
+            exemplar = (exlabels, float(m.group("exvalue")),
+                        float(m.group("exts")))
+        out.append((m.group("name"), labels, float(m.group("value")),
+                    exemplar))
+    return out, types
+
+
+def test_render_openmetrics_golden_roundtrips_exemplars():
+    reg = MetricsRegistry()
+    reg.record("GET /recommend/{userID}", 200, 0.0105,
+               trace_id="ab" * 16)
+    reg.record("GET /recommend/{userID}", 200, 0.120)
+    reg.record("GET /recommend/{userID}", 503, 30.0,
+               trace_id="cd" * 16)
+    reg.inc("partial_answers")
+    reg.set_gauge("update_lag_records", 4)
+    text = render_openmetrics(reg.prometheus_snapshot(),
+                              labels={"tier": "router"})
+    samples, types = _parse_openmetrics(text)
+    assert types["oryx_requests"] == "counter"
+    assert types["oryx_partial_answers"] == "counter"
+    assert types["oryx_update_lag_records"] == "gauge"
+    assert types["oryx_request_latency_ms"] == "histogram"
+    by = {(n, tuple(sorted(l.items()))): v
+          for n, l, v, _ in samples}
+    route = ("route", "GET /recommend/{userID}")
+    tier = ("tier", "router")
+    assert by[("oryx_requests_total", (route, tier))] == 3
+    assert by[("oryx_partial_answers_total", (tier,))] == 1
+    # buckets: cumulative, le canonical floats, +Inf last, count matches
+    buckets = [(l["le"], v, ex) for n, l, v, ex in samples
+               if n == "oryx_request_latency_ms_bucket"]
+    values = [v for _, v, _ in buckets]
+    assert values == sorted(values)
+    assert buckets[-1][0] == "+Inf"
+    assert buckets[-1][1] == 3
+    assert all("." in le or le == "+Inf" for le, _, _ in buckets)
+    # the two exemplars landed on their buckets and round-trip exactly
+    exemplars = {le: ex for le, _, ex in buckets if ex is not None}
+    le_10ms = repr(20.0)  # the 10.5 ms observation -> the (10, 20] bucket
+    assert exemplars[le_10ms][0] == {"trace_id": "ab" * 16}
+    assert exemplars[le_10ms][1] == pytest.approx(10.5)
+    assert exemplars["+Inf"][0] == {"trace_id": "cd" * 16}
+    assert exemplars["+Inf"][1] == pytest.approx(30000.0)
+    # exemplar timestamps are recent unix seconds
+    import time as _time
+    assert abs(exemplars["+Inf"][2] - _time.time()) < 60.0
+
+
+# -- bucket_quantile property tests (ISSUE 7 satellite) -----------------------
+
+def _random_counts(rng):
+    counts = [int(c) for c in rng.integers(0, 50,
+                                           len(LATENCY_BUCKETS_MS) + 1)]
+    if sum(counts) == 0:
+        counts[rng.integers(0, len(counts))] = 1
+    return counts
+
+
+def test_bucket_quantile_monotone_in_q():
+    rng = np.random.default_rng(42)
+    for _ in range(50):
+        counts = _random_counts(rng)
+        qs = [0.01, 0.1, 0.25, 0.5, 0.75, 0.9, 0.99]
+        vals = [bucket_quantile(counts, q) for q in qs]
+        assert all(v is not None for v in vals)
+        for lo, hi in zip(vals, vals[1:]):
+            assert lo <= hi + 1e-9, (counts, vals)
+
+
+def test_bucket_quantile_lands_in_target_bucket():
+    rng = np.random.default_rng(43)
+    for _ in range(50):
+        counts = _random_counts(rng)
+        total = sum(counts)
+        for q in (0.1, 0.5, 0.9, 0.99):
+            # the bucket the rank falls in, straight from the counts
+            rank = q * total
+            cum, target = 0, len(counts) - 1
+            for i, c in enumerate(counts):
+                cum += c
+                if cum >= rank:
+                    target = i
+                    break
+            v = bucket_quantile(counts, q)
+            lo = 0.0 if target == 0 else LATENCY_BUCKETS_MS[target - 1]
+            hi = LATENCY_BUCKETS_MS[min(target,
+                                        len(LATENCY_BUCKETS_MS) - 1)]
+            assert lo - 1e-9 <= v <= hi + 1e-9, \
+                (counts, q, v, target)
+
+
+def test_bucket_quantile_inf_bucket_reports_lower_bound():
+    counts = [0] * len(LATENCY_BUCKETS_MS) + [7]
+    # everything overflowed: nothing to interpolate toward, the +Inf
+    # bucket reports its lower bound (the last finite bound)
+    for q in (0.01, 0.5, 0.999):
+        assert bucket_quantile(counts, q) == LATENCY_BUCKETS_MS[-1]
+
+
+def test_bucket_quantile_all_zero_is_none():
+    assert bucket_quantile([0] * (len(LATENCY_BUCKETS_MS) + 1),
+                           0.99) is None
+    assert bucket_quantile([], 0.99) is None
 
 
 # -- review regressions -------------------------------------------------------

@@ -135,6 +135,54 @@ def test_dynamic_request_spans_are_catalogued(catalog):
         f"dynamic request spans missing from the catalog: {missing}")
 
 
+def _module_tuple(path: pathlib.Path, name: str) -> tuple[str, ...]:
+    """A module-level ``NAME = ("...", ...)`` string-tuple literal,
+    extracted via AST (no import needed)."""
+    tree = ast.parse(path.read_text(encoding="utf-8"),
+                     filename=str(path))
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == name
+                for t in node.targets):
+            value = node.value
+            assert isinstance(value, ast.Tuple), f"{name} not a tuple"
+            out = []
+            for el in value.elts:
+                assert isinstance(el, ast.Constant) \
+                    and isinstance(el.value, str), f"{name}: non-string"
+                out.append(el.value)
+            return tuple(out)
+    raise AssertionError(f"{name} not found in {path}")
+
+
+def test_anatomy_stage_names_are_catalogued(catalog):
+    """The /admin/tail stage taxonomy (obs/anatomy.py STAGES) must be
+    in the OBSERVABILITY.md stage table — same rot-prevention contract
+    as the span names.  Stages are tier.operation like spans, except
+    the designated residue bucket ``untraced``."""
+    stages = _module_tuple(SRC / "obs" / "anatomy.py", "STAGES")
+    assert len(stages) >= 5
+    missing = set(stages) - catalog
+    assert not missing, \
+        f"anatomy stages missing from the catalog: {sorted(missing)}"
+    for name in stages:
+        assert name == "untraced" or _SPAN_RE.fullmatch(name), \
+            f"stage {name!r} must be tier.operation snake_case"
+
+
+def test_wide_event_fields_are_catalogued(catalog):
+    """Every wide-event field (obs/events.py FIELDS) must be in the
+    OBSERVABILITY.md schema table, snake_case."""
+    fields = _module_tuple(SRC / "obs" / "events.py", "FIELDS")
+    assert len(fields) >= 6
+    missing = set(fields) - catalog
+    assert not missing, \
+        f"wide-event fields missing from the catalog: {sorted(missing)}"
+    for name in fields:
+        assert _NAME_RE.fullmatch(name), \
+            f"wide-event field {name!r} must be snake_case"
+
+
 def test_names_follow_the_naming_rules(source_names):
     bad = []
     for name, sites in sorted(source_names["span"].items()):

@@ -77,6 +77,18 @@ def _config(tmp_path, broker_name, **extra):
         # every request sampled: the IT asserts on recorded span trees
         "oryx.obs.tracing.enabled": True,
         "oryx.obs.tracing.sample-ratio": 1.0,
+        # SLO engine armed on router + replicas (ISSUE 7): a latency
+        # objective generous enough that organic traffic stays good,
+        # and a fast-burn low enough that a handful of chaos-slowed
+        # requests inside the module's 5m window trips the page state
+        "oryx.obs.slo.enabled": True,
+        "oryx.obs.slo.resolution-sec": 1,
+        "oryx.obs.slo.fast-burn": 5.0,
+        "oryx.obs.slo.objectives.availability.kind": "availability",
+        "oryx.obs.slo.objectives.availability.target": 0.999,
+        "oryx.obs.slo.objectives.latency.kind": "latency",
+        "oryx.obs.slo.objectives.latency.target": 0.99,
+        "oryx.obs.slo.objectives.latency.threshold-ms": 1000,
         # fast cluster timings so membership transitions stay inside
         # the tier-1 budget
         "oryx.cluster.heartbeat-interval-ms": 60,
@@ -108,8 +120,10 @@ def _get(port, path, headers=None, timeout=15):
     with urllib.request.urlopen(req, timeout=timeout) as r:
         body = r.read()
         ctype = r.headers.get("Content-Type", "")
-        payload = body.decode("utf-8") if "text/plain" in ctype \
-            else json.loads(body or b"null")
+        # text expositions (prometheus text/plain, openmetrics'
+        # dedicated media type) come back as str; anything JSON parses
+        payload = json.loads(body or b"null") if "json" in ctype \
+            else body.decode("utf-8")
         return r.status, dict(r.headers), payload
 
 
@@ -159,7 +173,12 @@ def obs_cluster(tmp_path_factory):
         }), port=0)
         layer.start()
         replicas.append(layer)
-    router = RouterLayer(cfg_fn(), port=0)
+    # wide events on the ROUTER only: every in-proc layer shares one
+    # pid, so per-layer files would collide (production processes get
+    # distinct pids and may share a dir)
+    events_dir = tmp_path / "events"
+    router = RouterLayer(cfg_fn({
+        "oryx.obs.events.dir": str(events_dir)}), port=0)
     router.start()
     speed = SpeedLayer(cfg_fn({"oryx.obs.metrics-port": 0}))
     speed.start()
@@ -173,7 +192,7 @@ def obs_cluster(tmp_path_factory):
     _get(replicas[0].port, "/admin/profile?ms=1", timeout=90)
     yield {"cfg_fn": cfg_fn, "replicas": replicas, "router": router,
            "speed": speed, "broker": broker,
-           "profile_dir": profile_dir}
+           "profile_dir": profile_dir, "events_dir": events_dir}
     for layer in replicas + [router, speed]:
         try:
             layer.close()
@@ -188,16 +207,17 @@ def _user_ids(router_port):
 
 
 def _all_traces(cluster):
-    """Every tier's /admin/traces ring joined: trace id -> spans."""
-    router, replicas = cluster["router"], cluster["replicas"]
-    speed = cluster["speed"]
-    joined: dict[str, list[dict]] = {}
-    ports = [router.port] + [r.port for r in replicas] \
-        + [speed.obs_server.port]
-    for port in ports:
-        _, _, payload = _get(port, "/admin/traces")
-        for tid, spans in payload["traces"].items():
-            joined.setdefault(tid, []).extend(spans)
+    """Cluster-complete traces: the router's server-side ``?join=1``
+    fan-in (ISSUE 7 — it scrapes both replicas via the scatter
+    registry, replacing this helper's old by-hand join), plus the
+    speed tier's side-door ring (not a scatter target)."""
+    router, speed = cluster["router"], cluster["speed"]
+    _, _, payload = _get(router.port, "/admin/traces?join=1&limit=128")
+    assert payload["joined_replicas"] == len(cluster["replicas"])
+    joined: dict[str, list[dict]] = dict(payload["traces"])
+    _, _, sp = _get(speed.obs_server.port, "/admin/traces")
+    for tid, spans in sp["traces"].items():
+        joined.setdefault(tid, []).extend(spans)
     return joined
 
 
@@ -424,7 +444,185 @@ def test_profile_slow_fault_pins_only_the_capture(obs_cluster):
     assert served_ms < box["profile"][2]["captured_ms"]
 
 
-# -- 5. /admin/profile gating + capture ---------------------------------------
+# -- 5. exemplar -> joined trace -> tail anatomy (ISSUE 7 tentpole) -----------
+
+_OM_EX_RE = __import__("re").compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(\{(?P<labels>.*?)\})? (?P<value>\S+)"
+    r"(?: # \{trace_id=\"(?P<trace>[0-9a-f]{32})\"\} "
+    r"(?P<exvalue>\S+) (?P<exts>\S+))?$")
+
+
+def _parse_om(text):
+    import re
+    assert text.rstrip("\n").endswith("# EOF")
+    out = []
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _OM_EX_RE.match(line)
+        assert m, f"unparseable OpenMetrics line: {line!r}"
+        labels = dict(re.findall(
+            r'([a-zA-Z_][a-zA-Z0-9_]*)="([^"]*)"', m.group("labels") or ""))
+        out.append((m.group("name"), labels, float(m.group("value")),
+                    m.group("trace")))
+    return out
+
+
+def test_exemplar_resolves_to_joined_trace_and_tail_sums(obs_cluster):
+    """The acceptance loop: a bucket exemplar scraped from the
+    router's MERGED OpenMetrics exposition names a trace id; that id
+    resolves via /admin/traces?join=1 to a full cluster-joined tree;
+    and its anatomy breakdown (the same decomposition /admin/tail
+    serves) sums to the request duration exactly."""
+    from oryx_tpu.obs import anatomy
+    router = obs_cluster["router"]
+    fresh_ids = set()
+    for uid in _user_ids(router.port)[:3]:
+        status, headers, _ = _get(router.port,
+                                  f"/recommend/{uid}?howMany=5")
+        assert status == 200
+        fresh_ids.add(headers["X-Oryx-Trace"])
+
+    _, headers, text = _get(router.port, "/metrics?format=openmetrics")
+    assert "openmetrics-text" in headers.get("Content-Type", "")
+    samples = _parse_om(text)
+    # the router's own data-plane buckets carry exemplars, and because
+    # newest-per-bucket wins, the buckets our fresh requests landed in
+    # name exactly those requests' trace ids
+    router_ex = {tr for n, l, v, tr in samples
+                 if tr and l.get("tier") == "router"
+                 and l.get("route") == "GET /recommend/{userID}"}
+    assert router_ex & fresh_ids, (router_ex, fresh_ids)
+    # ...and so does the MERGED replica block: replica-side exemplars
+    # survive the cross-replica merge (newest per bucket wins), naming
+    # the SAME trace ids (the replicas continued the inbound context)
+    replica_ex = {tr for n, l, v, tr in samples
+                  if tr and l.get("tier") == "replica"
+                  and l.get("route") == "GET /shard/recommend/{userID}"}
+    assert replica_ex & fresh_ids, (replica_ex, fresh_ids)
+
+    joined = _all_traces(obs_cluster)
+    for trace_id in (router_ex | replica_ex) & fresh_ids:
+        assert trace_id in joined, \
+            "exemplar trace id must resolve on the joined ring"
+    # a router-rooted exemplar trace decomposes over the JOINED tree
+    # (replica spans included) and the stages sum to the root duration
+    trace_id = next(iter(router_ex & fresh_ids))
+    breakdown = anatomy.analyze_trace(joined[trace_id])
+    assert breakdown is not None
+    assert breakdown["route"] == "GET /recommend/{userID}"
+    assert sum(breakdown["stages"].values()) == pytest.approx(
+        breakdown["total_ms"], rel=0.01)
+    # the replica-side stages are attributed (the join worked), not
+    # lumped into scatter wait
+    assert breakdown["stages"]["serving.device_execute"] > 0.0
+
+    # /admin/tail serves the same identity for its top-k entries
+    # (route-filtered: the joined ring also holds profile-capture and
+    # direct-shard traces that are not this route's tail)
+    _, _, report = _get(router.port, "/admin/tail?k=5&route=/recommend")
+    assert report["analyzed"] >= 3
+    assert report["joined_replicas"] == 2
+    share = report["tail"]["stage_share"]
+    assert sum(share.values()) == pytest.approx(1.0, abs=0.02)
+    for entry in report["top"]:
+        assert sum(entry["stages"].values()) == pytest.approx(
+            entry["total_ms"], rel=0.01)
+
+    # wide events (router-side): every sampled request left a durable
+    # line whose trace id ties back to the same rings
+    events_dir = obs_cluster["events_dir"]
+    files = list(events_dir.glob("events-router-*.jsonl"))
+    assert files, "router wide-event log missing"
+    lines = [json.loads(ln) for ln in
+             files[0].read_text().splitlines()]
+    by_trace = {ev.get("trace_id"): ev for ev in lines}
+    assert trace_id in by_trace
+    ev = by_trace[trace_id]
+    assert ev["route"] == "GET /recommend/{userID}"
+    assert ev["status"] == 200 and ev["sampled"] is True
+    assert ev["shards_called"] == 2
+
+
+def test_slow_shard_moves_stage_share_slo_burn_and_autoscaler(
+        obs_cluster):
+    """Chaos acceptance: a slow shard (emulated device delay on the
+    batcher seam) must (a) move /admin/tail's attributed stage share
+    onto serving.device_execute, (b) push the fast-window
+    slo_burn_rate gauge over the configured fast-burn into the page
+    state, and (c) be SEEN by the autoscaler's pure step() as SLO
+    pressure."""
+    from oryx_tpu.cluster.autoscaler import Autoscaler, AutoscalePolicy
+    router = obs_cluster["router"]
+    uids = _user_ids(router.port)
+    # baseline SLO snapshot (resolution-sec=1), then the incident
+    _get(router.port, "/metrics")
+    time.sleep(1.1)
+    faults.inject("serving-scan-dispatch", mode="delay",
+                  delay_sec=1.3, times=40)
+    try:
+        for uid in (uids * 3)[:6]:
+            status, _, _ = _get(router.port,
+                                f"/recommend/{uid}?howMany=5",
+                                timeout=30)
+            assert status == 200
+    finally:
+        faults.clear()
+    time.sleep(1.1)
+
+    # (a) the tail report attributes the incident to the device stage
+    _, _, report = _get(router.port,
+                        "/admin/tail?k=5&limit=256&route=/recommend")
+    share = report["tail"]["stage_share"]
+    assert share["serving.device_execute"] > 0.5, share
+    assert report["top"][0]["total_ms"] > 1000.0
+    assert report["top"][0]["stages"]["serving.device_execute"] > 1000.0
+
+    # (b) the latency objective burns past fast-burn (5.0) -> page
+    _, _, metrics = _get(router.port, "/metrics")
+    burn = metrics["freshness"]["slo_burn_rate"]
+    assert burn is not None and burn > 5.0, metrics["freshness"]
+    assert metrics["freshness"]["slo_error_budget_remaining"] < 1.0
+    _, _, slo_state = _get(router.port, "/admin/slo")
+    lat = slo_state["objectives"]["latency"]
+    assert lat["state"] == "page", lat
+    assert lat["windows"]["5m"]["burn"] >= 5.0
+
+    # (c) the autoscaler's poll sees the gauge and step() treats it as
+    # scale-up pressure (two consecutive polls -> spawn)
+    class _Launcher:
+        def __init__(self):
+            self.spawned = []
+
+        def spawn(self, shard, of):
+            self.spawned.append((shard, of))
+            return f"it-{shard}of{of}"
+
+        def retire(self, shard, of):
+            return None
+
+        def owned(self, of):
+            return {}
+
+    launcher = _Launcher()
+    sc = Autoscaler(
+        AutoscalePolicy(p99_high_ms=0, p99_low_ms=0,
+                        queue_wait_high_ms=0,
+                        update_lag_high_records=0, slo_burn_high=3.0,
+                        scale_up_after=2, cooldown_sec=0.0),
+        launcher, f"http://127.0.0.1:{router.port}")
+    s1 = sc.poll_signals()
+    assert s1.ok and s1.slo_burn_rate is not None \
+        and s1.slo_burn_rate > 3.0
+    assert sc.step(s1, now=0.0) is None       # streak discipline holds
+    action = sc.step(sc.poll_signals(), now=1.0)
+    assert action is not None and action["kind"] == "spawn"
+    assert "slo_burn" in action["reason"]
+    assert launcher.spawned and launcher.spawned[0][1] == 2
+
+
+# -- 6. /admin/profile gating + capture ---------------------------------------
 
 def test_admin_profile_capture_and_gating(obs_cluster):
     import os

@@ -1,0 +1,432 @@
+"""Unit tests for the tail-anatomy + SLO + wide-event layer (ISSUE 7):
+obs/anatomy.py's stage decomposition (pure over span dicts, sums
+exactly to the root duration), obs/slo.py's burn-rate math / alert
+state machine / config parsing / chaos freeze, and obs/events.py's
+emit gates, span-field derivation, rotation, and disk-full chaos."""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from oryx_tpu.common.config import from_dict
+from oryx_tpu.lambda_rt.metrics import MetricsRegistry
+from oryx_tpu.obs import anatomy, events, slo
+from oryx_tpu.resilience import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# -- anatomy ------------------------------------------------------------------
+
+def _router_trace(total=100.0, shard_ms=(80.0, 40.0), serving_ms=70.0,
+                  qw=10.0, de=50.0, merge=5.0, lead=5.0):
+    spans = [
+        {"name": "router.request", "trace_id": "t", "span_id": "r",
+         "parent_id": None, "start_ms": 0.0, "duration_ms": total,
+         "attrs": {"route": "GET /r", "http.status": 200},
+         "status": "ok"},
+        {"name": "router.merge", "span_id": "m", "parent_id": "r",
+         "start_ms": total - merge, "duration_ms": merge, "attrs": {}},
+    ]
+    for i, d in enumerate(shard_ms):
+        spans.append({"name": "router.shard_call", "span_id": f"c{i}",
+                      "parent_id": "r", "start_ms": lead,
+                      "duration_ms": d, "attrs": {"shard": i},
+                      "status": "ok"})
+    # the slowest shard's replica-side tree (what ?join=1 contributes)
+    spans += [
+        {"name": "serving.request", "span_id": "s0", "parent_id": "c0",
+         "start_ms": lead + 2.0, "duration_ms": serving_ms,
+         "attrs": {}, "status": "ok"},
+        {"name": "serving.queue_wait", "span_id": "q0",
+         "parent_id": "s0", "duration_ms": qw},
+        {"name": "serving.device_execute", "span_id": "d0",
+         "parent_id": "s0", "duration_ms": de,
+         "attrs": {"batch_size": 3, "kernel_route": "int8_fold"}},
+    ]
+    return spans
+
+
+def test_analyze_router_trace_sums_exactly_to_total():
+    b = anatomy.analyze_trace(_router_trace())
+    assert b["trace_id"] == "t" and b["route"] == "GET /r"
+    s = b["stages"]
+    assert set(s) == set(anatomy.STAGES)
+    assert sum(s.values()) == pytest.approx(b["total_ms"], abs=1e-6)
+    # the slowest shard (80 ms) attributes, not the fast one
+    assert s["serving.device_execute"] == pytest.approx(50.0)
+    assert s["serving.queue_wait"] == pytest.approx(10.0)
+    assert s["serving.request"] == pytest.approx(10.0)  # 70 - 10 - 50
+    assert s["scatter.wait"] == pytest.approx(10.0)     # 80 - 70
+    assert s["router.merge"] == pytest.approx(5.0)
+    assert s["router.dispatch"] == pytest.approx(5.0)   # timeline lead
+    assert s["untraced"] == pytest.approx(10.0)  # 100-80-5-5
+
+
+def test_analyze_clamps_overlong_children():
+    # a retroactive child longer than its parent must not push the
+    # breakdown past the total
+    spans = _router_trace(total=50.0, shard_ms=(200.0,),
+                          serving_ms=500.0, qw=400.0, de=400.0)
+    b = anatomy.analyze_trace(spans)
+    assert sum(b["stages"].values()) == pytest.approx(50.0, abs=1e-6)
+    assert all(v >= 0.0 for v in b["stages"].values())
+
+
+def test_analyze_single_node_trace():
+    spans = [
+        {"name": "serving.request", "trace_id": "t", "span_id": "s",
+         "parent_id": None, "start_ms": 0.0, "duration_ms": 40.0,
+         "attrs": {"route": "GET /recommend/{userID}"}, "status": "ok"},
+        {"name": "serving.queue_wait", "span_id": "q",
+         "parent_id": "s", "duration_ms": 5.0},
+        {"name": "serving.device_execute", "span_id": "d",
+         "parent_id": "s", "duration_ms": 30.0, "attrs": {}},
+    ]
+    b = anatomy.analyze_trace(spans)
+    s = b["stages"]
+    assert s["serving.queue_wait"] == pytest.approx(5.0)
+    assert s["serving.device_execute"] == pytest.approx(30.0)
+    assert s["serving.request"] == pytest.approx(5.0)
+    assert sum(s.values()) == pytest.approx(40.0, abs=1e-6)
+
+
+def test_analyze_rootless_fragment_is_none():
+    assert anatomy.analyze_trace(
+        [{"name": "serving.queue_wait", "span_id": "q",
+          "parent_id": "s", "duration_ms": 5.0}]) is None
+
+
+def test_analyze_orphan_root_replica_local_ring():
+    """A replica analyzing its OWN ring sees serving.request spans
+    parented under the router's shard_call — which lives in another
+    process's ring.  Such an orphan .request is still a perfectly
+    analyzable local root (the replica-local /admin/tail view)."""
+    spans = [
+        {"name": "serving.request", "trace_id": "t", "span_id": "s",
+         "parent_id": "router-side-id", "start_ms": 0.0,
+         "duration_ms": 40.0,
+         "attrs": {"route": "GET /shard/recommend/{userID}"},
+         "status": "ok"},
+        {"name": "serving.queue_wait", "span_id": "q",
+         "parent_id": "s", "duration_ms": 5.0},
+        {"name": "serving.device_execute", "span_id": "d",
+         "parent_id": "s", "duration_ms": 30.0, "attrs": {}},
+    ]
+    b = anatomy.analyze_trace(spans)
+    assert b is not None and b["total_ms"] == pytest.approx(40.0)
+    assert b["stages"]["serving.device_execute"] == pytest.approx(30.0)
+    # but when the router's root IS in the (joined) span set, it wins
+    joined = spans + [
+        {"name": "router.request", "trace_id": "t", "span_id":
+         "router-root", "parent_id": None, "start_ms": 0.0,
+         "duration_ms": 60.0, "attrs": {"route": "GET /r"},
+         "status": "ok"},
+        {"name": "router.shard_call", "span_id": "router-side-id",
+         "parent_id": "router-root", "start_ms": 2.0,
+         "duration_ms": 45.0, "attrs": {"shard": 0}, "status": "ok"},
+    ]
+    b2 = anatomy.analyze_trace(joined)
+    assert b2["total_ms"] == pytest.approx(60.0)
+    assert b2["route"] == "GET /r"
+
+
+def test_tail_report_shares_and_topk():
+    traces = {}
+    # 30 fast traces + 2 slow ones dominated by device time
+    for i in range(30):
+        traces[f"f{i}"] = _router_trace(total=20.0, shard_ms=(15.0,),
+                                        serving_ms=14.0, qw=1.0,
+                                        de=12.0, merge=1.0, lead=1.0)
+    for i in range(2):
+        traces[f"s{i}"] = _router_trace(total=500.0, shard_ms=(480.0,),
+                                        serving_ms=470.0, qw=10.0,
+                                        de=450.0, merge=5.0, lead=5.0)
+    rep = anatomy.tail_report(traces, top_k=3)
+    assert rep["analyzed"] == 32 and rep["skipped"] == 0
+    share = rep["tail"]["stage_share"]
+    assert sum(share.values()) == pytest.approx(1.0, abs=0.01)
+    assert share["serving.device_execute"] > 0.8
+    assert [t["total_ms"] for t in rep["top"]] == \
+        sorted((t["total_ms"] for t in rep["top"]), reverse=True)
+    assert rep["top"][0]["total_ms"] == pytest.approx(500.0)
+    # per-stage histograms cover every analyzed trace
+    assert sum(rep["stages"]["serving.device_execute"]["buckets"]) == 32
+
+
+def test_tail_report_route_prefix_filter():
+    traces = {"a": _router_trace()}
+    spans_other = _router_trace()
+    spans_other[0] = dict(spans_other[0],
+                          attrs={"route": "GET /admin/profile"})
+    traces["b"] = spans_other
+    rep = anatomy.tail_report(traces, route_prefix="/r")
+    assert rep["analyzed"] == 1 and rep["skipped"] == 1
+    assert rep["top"][0]["route"] == "GET /r"
+
+
+def test_tail_report_empty_ring():
+    rep = anatomy.tail_report({})
+    assert rep["analyzed"] == 0 and rep["p99_ms"] is None
+    assert rep["top"] == []
+
+
+# -- SLO engine ---------------------------------------------------------------
+
+def _fill(reg, route, n, ms, status=200):
+    for _ in range(n):
+        reg.record(route, status, ms / 1000.0)
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def _engine(reg, objectives, **kw):
+    clock = _Clock()
+    eng = slo.SloEngine(objectives, reg, resolution_sec=1.0,
+                        clock=clock, **kw)
+    return eng, clock
+
+
+def test_latency_objective_burn_and_page_state():
+    reg = MetricsRegistry()
+    eng, clock = _engine(
+        reg, [slo.SloObjective("lat", "latency", target=0.99,
+                               threshold_ms=200.0)])
+    _fill(reg, "GET /r", 98, 50.0)
+    _fill(reg, "GET /r", 2, 500.0)        # 2% over threshold
+    st = eng.evaluate()["objectives"]["lat"]
+    # err 0.02 / budget 0.01 -> burn 2.0 on every window: no page
+    assert st["windows"]["5m"]["burn"] == pytest.approx(2.0)
+    assert st["state"] == "ok"
+    clock.t += 10.0
+    _fill(reg, "GET /r", 50, 500.0)       # a real incident
+    st = eng.evaluate()["objectives"]["lat"]
+    assert st["windows"]["5m"]["burn"] >= 14.4
+    assert st["state"] == "page"
+    assert st["transitions"] == 1
+    assert eng.burn_gauge() >= 14.4
+    # budget consumed = burn(6h) x 6h/30d: a finite bite out of the
+    # period's budget, never "all gone" from one window's burn
+    burn6 = st["windows"]["6h"]["burn"]
+    want = max(0.0, 1.0 - burn6 * (21600.0 / (30 * 24 * 3600.0)))
+    assert eng.budget_gauge() == pytest.approx(want, abs=1e-3)
+    assert 0.0 < eng.budget_gauge() < 1.0
+
+
+def test_availability_objective_counts_server_errors():
+    reg = MetricsRegistry()
+    eng, _ = _engine(
+        reg, [slo.SloObjective("avail", "availability", target=0.999)])
+    _fill(reg, "GET /r", 99, 10.0)
+    _fill(reg, "GET /r", 1, 10.0, status=503)
+    _fill(reg, "GET /r", 5, 10.0, status=404)   # 4xx never count bad
+    st = eng.evaluate()["objectives"]["avail"]
+    w = st["windows"]["5m"]
+    assert w["total"] == 105 and w["total"] - w["good"] == 1
+    assert w["burn"] == pytest.approx((1 / 105) / 0.001, rel=1e-3)
+
+
+def test_window_baseline_uses_ring_history():
+    reg = MetricsRegistry()
+    eng, clock = _engine(
+        reg, [slo.SloObjective("lat", "latency", target=0.99,
+                               threshold_ms=200.0)])
+    _fill(reg, "GET /r", 1000, 500.0)     # ancient all-bad history
+    eng.evaluate()
+    # an hour later the incident is long over: fresh traffic is clean
+    clock.t += 4000.0
+    _fill(reg, "GET /r", 100, 10.0)
+    st = eng.evaluate()["objectives"]["lat"]
+    # the 5m window baseline is the old snapshot just before the
+    # window start -> only the 100 new good requests are inside
+    assert st["windows"]["5m"]["total"] == 100
+    assert st["windows"]["5m"]["burn"] == 0.0
+    # the 6h window still sees the whole incident
+    assert st["windows"]["6h"]["total"] == 1100
+    assert st["state"] != "page"
+
+
+def test_control_plane_routes_never_vote():
+    reg = MetricsRegistry()
+    eng, _ = _engine(
+        reg, [slo.SloObjective("avail", "availability", target=0.99)])
+    _fill(reg, "GET /metrics", 50, 10.0, status=503)
+    _fill(reg, "GET /admin/traces", 50, 10.0, status=503)
+    _fill(reg, "GET /shard/recommend/{userID}", 5, 10.0, status=503)
+    st = eng.evaluate()["objectives"]["avail"]
+    assert st["windows"]["5m"]["total"] == 0
+    assert st["state"] == "ok"
+
+
+def test_route_prefix_objective():
+    reg = MetricsRegistry()
+    eng, _ = _engine(
+        reg, [slo.SloObjective("rec", "latency", target=0.99,
+                               threshold_ms=200.0,
+                               route_prefix="/recommend")])
+    _fill(reg, "GET /recommend/{userID}", 10, 500.0)
+    _fill(reg, "GET /similarity/{itemIDs:+}", 10, 500.0)
+    st = eng.evaluate()["objectives"]["rec"]
+    assert st["windows"]["5m"]["total"] == 10   # only /recommend votes
+
+
+def test_eval_error_chaos_freezes_state_and_counts():
+    reg = MetricsRegistry()
+    eng, clock = _engine(
+        reg, [slo.SloObjective("lat", "latency", target=0.99,
+                               threshold_ms=200.0)])
+    _fill(reg, "GET /r", 100, 500.0)      # everything bad -> page
+    before = eng.evaluate()["objectives"]["lat"]["state"]
+    assert before == "page"
+    clock.t += 10.0
+    # recovery traffic deep enough to dilute even the 6h window's
+    # burn below the ticket line...
+    _fill(reg, "GET /r", 20000, 10.0)
+    faults.inject("obs-slo-eval-error", mode="error", times=1)
+    st = eng.evaluate()                   # ...which the evaluator
+    assert st["objectives"]["lat"]["state"] == "page"  # never sees
+    assert eng.eval_failures == 1
+    assert reg.counters_snapshot()["slo_eval_failures"] == 1
+    # next (clean) evaluation thaws and recovers
+    clock.t += 10.0
+    assert eng.evaluate()["objectives"]["lat"]["state"] == "ok"
+
+
+def test_engine_from_config_parses_objectives_and_gates():
+    reg = MetricsRegistry()
+    assert slo.engine_from_config(from_dict({}), reg) is None
+    cfg = from_dict({
+        "oryx.obs.slo.enabled": True,
+        "oryx.obs.slo.objectives.availability.kind": "availability",
+        "oryx.obs.slo.objectives.availability.target": 0.999,
+        "oryx.obs.slo.objectives.lat.kind": "latency",
+        "oryx.obs.slo.objectives.lat.target": 0.99,
+        "oryx.obs.slo.objectives.lat.threshold-ms": 200,
+        "oryx.obs.slo.objectives.lat.route-prefix": "/recommend",
+    })
+    eng = slo.engine_from_config(cfg, reg)
+    by = {o.name: o for o in eng.objectives}
+    assert by["availability"].kind == "availability"
+    assert by["lat"].threshold_ms == 200.0
+    assert by["lat"].route_prefix == "/recommend"
+    assert eng.fast_burn == 14.4 and eng.slow_burn == 6.0
+
+
+def test_latency_threshold_must_sit_on_a_bucket_bound():
+    with pytest.raises(ValueError, match="bucket"):
+        slo.SloObjective("x", "latency", target=0.99, threshold_ms=123.0)
+    with pytest.raises(ValueError, match="kind"):
+        slo.SloObjective("x", "weird")
+
+
+# -- wide-event log -----------------------------------------------------------
+
+def _read_events(log):
+    with open(log.path, encoding="utf-8") as f:
+        return [json.loads(line) for line in f]
+
+
+def test_emit_gates_sampled_error_and_slow(tmp_path):
+    log = events.WideEventLog(str(tmp_path), "t", always_slow_ms=1000)
+    assert log.should_emit(200, 5.0, sampled=True)
+    assert not log.should_emit(200, 5.0, sampled=False)
+    assert log.should_emit(503, 5.0, sampled=False)   # server error
+    assert log.should_emit(0, 5.0, sampled=False)     # conn died
+    assert not log.should_emit(404, 5.0, sampled=False)
+    assert log.should_emit(200, 1500.0, sampled=False)  # slow
+    # with no slow threshold, slow-but-ok unsampled stays silent
+    log2 = events.WideEventLog(str(tmp_path), "t2")
+    assert not log2.should_emit(200, 99999.0, sampled=False)
+
+
+def test_emit_derives_span_fields(tmp_path):
+    log = events.WideEventLog(str(tmp_path), "router")
+    spans = [
+        {"name": "router.shard_call", "status": "ok", "attrs": {}},
+        {"name": "router.shard_call", "status": "error", "attrs": {}},
+        {"name": "router.merge", "attrs": {"shards_merged": 1}},
+        {"name": "serving.queue_wait", "duration_ms": 7.25},
+        {"name": "serving.device_execute", "duration_ms": 30.0,
+         "attrs": {"batch_size": 4, "kernel_route": "int8_fold"}},
+    ]
+    log.emit("GET /recommend/{userID}", 200, 55.5, "ab" * 16, spans)
+    (ev,) = _read_events(log)
+    assert ev["route"] == "GET /recommend/{userID}"
+    assert ev["trace_id"] == "ab" * 16 and ev["sampled"] is True
+    assert ev["latency_ms"] == 55.5
+    assert ev["shards_called"] == 2 and ev["shard_errors"] == 1
+    assert ev["shards_merged"] == 1
+    assert ev["queue_wait_ms"] == 7.25
+    assert ev["batch_size"] == 4
+    assert ev["kernel_route"] == "int8_fold"
+    # every emitted key is in the documented schema
+    assert set(ev) <= set(events.FIELDS)
+    # unsampled error line: minimal fields, no trace id
+    log.emit("GET /r", 503, 9.9, None, None)
+    ev2 = _read_events(log)[1]
+    assert "trace_id" not in ev2 and ev2["sampled"] is False
+
+
+def test_rotation_keeps_max_files(tmp_path):
+    log = events.WideEventLog(str(tmp_path), "t", max_bytes=400,
+                              max_files=3)
+    for i in range(50):
+        log.emit(f"GET /r{i}", 200, 1.0, "ab" * 16, None)
+    files = sorted(os.listdir(tmp_path))
+    base = os.path.basename(log.path)
+    assert base in files
+    assert f"{base}.1" in files and f"{base}.2" in files
+    assert f"{base}.3" not in files
+    assert os.path.getsize(log.path) <= 400
+    # the newest line is in the live file
+    assert _read_events(log)[-1]["route"] == "GET /r49"
+
+
+def test_disk_full_chaos_drops_and_counts(tmp_path):
+    reg = MetricsRegistry()
+    log = events.WideEventLog(str(tmp_path), "t", registry=reg)
+    faults.inject("obs-event-disk-full", mode="error", times=2)
+    log.emit("GET /r", 200, 1.0, "ab" * 16, None)  # must NOT raise
+    log.emit("GET /r", 200, 1.0, "cd" * 16, None)
+    log.emit("GET /r", 200, 1.0, "ef" * 16, None)  # fault disarmed
+    assert log.dropped == 2 and log.emitted == 1
+    assert reg.counters_snapshot()["event_write_failures"] == 2
+    assert len(_read_events(log)) == 1
+
+
+def test_events_from_config_gates_on_dir(tmp_path):
+    reg = MetricsRegistry()
+    assert events.events_from_config(from_dict({}), "t", reg) is None
+    cfg = from_dict({"oryx.obs.events.dir": str(tmp_path),
+                     "oryx.obs.events.always-slow-ms": 250})
+    log = events.events_from_config(cfg, "serving", reg)
+    assert log is not None
+    assert log.always_slow_ms == 250
+    assert log.max_bytes == 16777216 and log.max_files == 4
+    assert "events-serving-" in log.path
+    log.close()
+
+
+def test_emit_after_close_drops_instead_of_resurrecting(tmp_path):
+    log = events.WideEventLog(str(tmp_path), "t")
+    log.emit("GET /r", 200, 1.0, "ab" * 16, None)
+    log.close()
+    # a handler thread outliving close() must not reopen the file
+    log.emit("GET /r", 200, 1.0, "cd" * 16, None)
+    assert log.dropped == 1 and log.emitted == 1
+    assert log._f is None
+    assert len(_read_events(log)) == 1
