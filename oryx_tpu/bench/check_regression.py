@@ -10,7 +10,10 @@ Three artifact families share the machinery, selected by ``--kind``:
   (features, items, replicas, replicas-per-shard) — the
   scatter-gather cluster's per-topology scaling rounds (R-way
   replica-group cells gate independently of their R=1 siblings;
-  pre-r09 artifacts are all R=1).
+  pre-r09 artifacts are all R=1).  Since r11 a row's hot-user Zipf
+  rung gates as its own ``(..., "zipf")`` pseudo-cell — a
+  result-cache regression cannot hide behind a healthy cold cell,
+  and pre-cache artifacts simply lack the cell.
 - ``obs``: ``BENCH_OBS_OVERHEAD_*.json`` — the observability
   hot-path microbench (bench/obs_overhead.py).  Gates on two rules:
   a HARD absolute budget (the unsampled per-request pipeline must
@@ -131,10 +134,22 @@ def _cells(doc: dict) -> dict:
     if doc.get("metric") == "gateway_recommend_scaling":
         # per-replica-count scaling cells (bench/gateway.py); the
         # replica-group size R joined the key in r09 — pre-elastic
-        # rounds are all R=1, so they keep gating the R=1 cells
-        return {(r["features"], r["items"], r["replicas"],
-                 r.get("replicas_per_shard", 1)): r
-                for r in doc.get("rows", [])}
+        # rounds are all R=1, so they keep gating the R=1 cells.
+        # r11 added the hot-user Zipf rung: it gates as its own
+        # pseudo-cell (base key + "zipf") so a result-cache
+        # regression cannot hide behind a healthy cold cell — and
+        # pre-cache artifacts simply lack the cell (reported new,
+        # never compared)
+        out = {}
+        for r in doc.get("rows", []):
+            key = (r["features"], r["items"], r["replicas"],
+                   r.get("replicas_per_shard", 1))
+            out[key] = r
+            z = r.get("zipf")
+            if isinstance(z, dict) \
+                    and z.get("open_loop_sustained_qps") is not None:
+                out[key + ("zipf",)] = z
+        return out
     return {(r["features"], r["items"], r["lsh"]): r
             for r in doc.get("rows", [])}
 
@@ -144,6 +159,8 @@ def _cell_label(doc: dict, key: tuple) -> str:
         label = f"{key[0]}f/{key[1] / 1e6:g}M/{key[2]}rep"
         if key[3] != 1:
             label += f"x{key[3]}"
+        if len(key) > 4:
+            label += f"/{key[4]}"
         return label
     return f"{key[0]}f/{key[1] / 1e6:g}M{'/lsh' if key[2] else ''}"
 

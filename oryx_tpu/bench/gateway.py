@@ -42,9 +42,21 @@ add an overload rung driven well past the sustained ceiling, recording
 how much of the overload degraded to fast 503 + ``Retry-After`` instead
 of collapse.
 
-Writes ``BENCH_GATEWAY_r09.json``; ``bench/check_regression.py
+The router's exact result cache + single-flight coalescing
+(``cluster/result_cache.py``) is armed by default: the uniform ladder
+flushes the cache before every rung AND cache-busts every request with
+a unique query arg (a genuinely cold miss-path cell, comparable with
+pre-cache rounds — a plain uniform draw repeats users within a rung
+and the accidental hits would inflate the gated number), ``--zipf a``
+adds a hot-user rung whose hit rate builds across the ladder
+(headline: sustained qps multiple over the cold cell + cached-hit
+p50), and ``--coalesce-burst B`` fires waves of identical concurrent
+requests that must collapse onto one scatter.
+
+Writes ``BENCH_GATEWAY_r11.json``; ``bench/check_regression.py
 --kind gateway`` gates successive rounds per (features, items,
-replicas, replicas-per-shard) cell.
+replicas, replicas-per-shard) cell, plus a ``zipf`` pseudo-cell per
+row when the hot-user rung ran.
 """
 
 from __future__ import annotations
@@ -158,6 +170,83 @@ def _get_json(port: int, path: str, timeout: float = 10.0):
     with urllib.request.urlopen(
             f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
         return json.loads(r.read() or b"null")
+
+
+def _flush_cache(port: int) -> None:
+    """Drop the router's result-cache entries (404 = cache off)."""
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/admin/cache/flush", data=b"",
+        method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            r.read()
+    except urllib.error.HTTPError as e:
+        e.read()
+
+
+def _cache_stats(port: int):
+    try:
+        return _get_json(port, "/admin/cache")
+    except urllib.error.HTTPError as e:
+        e.read()
+        return None
+
+
+def _coalesce_burst_probe(port: int, user_ids: list[str],
+                          burst: int, waves: int = 10) -> dict:
+    """Single-flight measurement: per wave, ``burst`` IDENTICAL
+    concurrent requests against a cold key — the leader scatters once
+    and the followers must latch on (verdict ``coalesced``) or, having
+    arrived after completion, hit the stored entry.  The per-cell
+    evidence that a thundering herd on one hot key costs ONE device
+    dispatch."""
+    import threading as th
+    tallies: dict[str, int] = {}
+    lat: list[float] = []
+    errors = 0
+    _flush_cache(port)
+    for w in range(waves):
+        uid = user_ids[w % len(user_ids)]
+        url = (f"http://127.0.0.1:{port}/recommend/{uid}"
+               "?howMany=10&offset=1")  # offset: distinct from ladder keys
+        results: list[tuple[int, str | None, float]] = []
+        lock = th.Lock()
+        barrier = th.Barrier(burst)
+
+        def one():
+            barrier.wait()
+            t0 = time.monotonic()
+            status, verdict = 0, None
+            try:
+                with urllib.request.urlopen(url, timeout=60) as r:
+                    r.read()
+                    status = r.status
+                    verdict = r.headers.get("X-Oryx-Cache")
+            except Exception:  # noqa: BLE001 — counted
+                pass
+            with lock:
+                results.append((status, verdict,
+                                (time.monotonic() - t0) * 1000.0))
+
+        threads = [th.Thread(target=one, daemon=True)
+                   for _ in range(burst)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(90.0)
+        for status, verdict, ms in results:
+            if status != 200:
+                errors += 1
+                continue
+            tallies[verdict or "unstamped"] = \
+                tallies.get(verdict or "unstamped", 0) + 1
+            lat.append(ms)
+    out = {"burst": burst, "waves": waves, "errors": errors,
+           "verdicts": tallies}
+    if lat:
+        out["p50_ms"] = round(float(np.percentile(lat, 50)), 1)
+        out["p95_ms"] = round(float(np.percentile(lat, 95)), 1)
+    return out
 
 
 def _await(predicate, what: str, timeout: float = 300.0) -> None:
@@ -332,7 +421,10 @@ def run_cell(replicas: int, items: int, features: int, users: int,
              replicas_per_shard: int = 1,
              kill_member_probe: bool = False,
              admission: dict | None = None,
-             overload_factor: float = 3.0) -> dict:
+             overload_factor: float = 3.0,
+             cache: bool = True,
+             zipf: float = 0.0,
+             coalesce_burst: int = 0) -> dict:
     publish_s = 0.0
     if broker_dir is None:
         broker_dir = os.path.join(work_dir, f"broker-{replicas}")
@@ -429,6 +521,17 @@ def run_cell(replicas: int, items: int, features: int, users: int,
                 max(1000, int(5 * delay))
         if admission:
             router_extra.update(admission)
+        if cache:
+            # the exact result cache + single-flight coalescing
+            # (cluster/result_cache.py): armed for every rung — the
+            # uniform ladder flushes before each rung so it stays a
+            # miss-path (overhead) measurement, the Zipf rung lets the
+            # hot-user hit rate build, the burst rung measures the
+            # latch
+            router_extra.update({
+                "oryx.cluster.cache.enabled": True,
+                "oryx.cluster.coalesce.enabled": True,
+            })
         _write_conf(conf, broker_dir, router_port, router_extra)
         procs.append(_spawn(["router"], conf, None, log_path))
 
@@ -484,24 +587,65 @@ def run_cell(replicas: int, items: int, features: int, users: int,
             f"http://127.0.0.1:{router_port}", user_ids, rate_qps=30,
             duration_sec=max(6.0, duration_sec), workers=64)
 
-        ladder, best = [], None
-        for rate in rates:
-            out = run_recommend_open_loop(
-                f"http://127.0.0.1:{router_port}", user_ids,
-                rate_qps=rate, duration_sec=duration_sec,
-                workers=min(256, max(64, int(rate))))
-            if not out["sustained"]:
-                # one retry absorbs a transient stall (a late compile,
-                # a heartbeat-file fsync burst) before the rung counts
-                out = run_recommend_open_loop(
-                    f"http://127.0.0.1:{router_port}", user_ids,
-                    rate_qps=rate, duration_sec=duration_sec,
-                    workers=min(256, max(64, int(rate))))
-            ladder.append(out)
-            if out["sustained"]:
-                best = out
-            else:
-                break
+        def _run_ladder(flush_each_rung: bool, zipf_a=None,
+                        cache_bust=False):
+            """Walk the rate ladder to the highest sustained rung; one
+            retry per rung absorbs a transient stall (a late compile,
+            a heartbeat-file fsync burst) before the rung counts."""
+            ladder, best = [], None
+            for rate in rates:
+                out = None
+                for _attempt in range(2):
+                    if flush_each_rung:
+                        _flush_cache(router_port)
+                    out = run_recommend_open_loop(
+                        f"http://127.0.0.1:{router_port}", user_ids,
+                        rate_qps=rate, duration_sec=duration_sec,
+                        workers=min(256, max(64, int(rate))),
+                        zipf_a=zipf_a, cache_bust=cache_bust)
+                    if out["sustained"]:
+                        break
+                ladder.append(out)
+                if out["sustained"]:
+                    best = out
+                else:
+                    break
+            return ladder, best
+
+        # uniform COLD (miss-path) cell, comparable with pre-cache
+        # rounds: every rung starts from an empty cache AND every
+        # request carries a unique cache-busting arg — without it a
+        # uniform draw repeats users within a rung (birthday effect)
+        # and the accidental hits would inflate the gated cold number,
+        # masking scatter-path regressions behind the cache
+        ladder, best = _run_ladder(flush_each_rung=cache,
+                                   cache_bust=cache)
+
+        # hot-user Zipf rung (the result cache's design load): same
+        # rate ladder, skewed user draw, NO flushes between rungs —
+        # the hit rate builds exactly as production's would.  Headline
+        # = sustained qps vs the cold cell + the cached-hit p50.
+        zipf_report = None
+        if cache and zipf > 0:
+            _flush_cache(router_port)
+            z_ladder, z_best = _run_ladder(flush_each_rung=False,
+                                           zipf_a=zipf)
+            zipf_report = {
+                "a": zipf,
+                "open_loop_sustained_qps":
+                    z_best["achieved_qps"] if z_best else 0.0,
+                "sustained_p50_ms": z_best["p50_ms"] if z_best else None,
+                "cache": z_best.get("cache") if z_best else None,
+                "admin_cache": _cache_stats(router_port),
+                "ladder": z_ladder,
+            }
+
+        # single-flight burst rung: a thundering herd on one cold hot
+        # key must collapse to one scatter
+        burst_report = None
+        if cache and coalesce_burst > 1:
+            burst_report = _coalesce_burst_probe(
+                router_port, user_ids, coalesce_burst)
         if best and best.get("worst_sampled"):
             # worst sampled requests of the best rung: each trace id
             # names a recorded span tree on the router's /admin/traces
@@ -563,6 +707,10 @@ def run_cell(replicas: int, items: int, features: int, users: int,
                 best["achieved_qps"] if best else 0.0,
             "sustained_p50_ms": best["p50_ms"] if best else None,
             "sustained_p95_ms": best["p95_ms"] if best else None,
+            "cache_armed": cache,
+            "zipf": zipf_report,
+            "coalesce_burst": burst_report,
+            "cache_stats_after_run": _cache_stats(router_port),
             "kill_probe": kill_probe,
             "admission": admission or None,
             "admission_stats_after_ladder": admission_stats,
@@ -651,7 +799,27 @@ def main(argv: list[str] | None = None) -> int:
                          "regression-gated baseline cells un-gated — "
                          "exactly the configuration their previous "
                          "rounds ran")
-    ap.add_argument("--out", default="BENCH_GATEWAY_r09.json")
+    ap.add_argument("--cache", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="arm the router's exact result cache + "
+                         "single-flight coalescing "
+                         "(oryx.cluster.cache.* / coalesce.*).  The "
+                         "uniform ladder flushes before every rung "
+                         "and cache-busts every request so it stays a "
+                         "cold/miss-path cell comparable with "
+                         "pre-cache rounds; --no-cache reproduces "
+                         "the pre-r11 router exactly")
+    ap.add_argument("--zipf", type=float, default=0.0,
+                    help="hot-user Zipf rung: rerun the rate ladder "
+                         "with user picks drawn ∝ 1/rank^a (this "
+                         "exponent), hit rate building across rungs — "
+                         "the result cache's design load.  0 = off")
+    ap.add_argument("--coalesce-burst", type=int, default=0,
+                    help="single-flight rung: waves of this many "
+                         "IDENTICAL concurrent requests against a "
+                         "cold key — the herd must collapse to one "
+                         "scatter (verdicts tallied).  0 = off")
+    ap.add_argument("--out", default="BENCH_GATEWAY_r11.json")
     ap.add_argument("--keep-work", action="store_true")
     args = ap.parse_args(argv)
 
@@ -712,7 +880,10 @@ def main(argv: list[str] | None = None) -> int:
                 replicas_per_shard=rps,
                 kill_member_probe=args.kill_probe,
                 admission=cell_admission,
-                overload_factor=args.overload_factor)
+                overload_factor=args.overload_factor,
+                cache=args.cache,
+                zipf=args.zipf,
+                coalesce_burst=args.coalesce_burst)
             row["publish_s"] = publish_s
             rows.append(row)
             print(json.dumps({k: v for k, v in rows[-1].items()
@@ -727,6 +898,8 @@ def main(argv: list[str] | None = None) -> int:
             for r in rows if r["replicas_per_shard"] == 1}
     report = {
         "metric": "gateway_recommend_scaling",
+        "cache_armed": args.cache,
+        "zipf_a": args.zipf or None,
         "tracing_sample": args.tracing_sample,
         "emulated_device_ms_per_mrow": args.device_ms_per_mrow,
         "backend": "cpu" if os.environ.get(

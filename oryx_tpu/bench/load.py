@@ -31,7 +31,8 @@ from ..common.rand import RandomManager
 _log = logging.getLogger(__name__)
 
 __all__ = ["StaticModelManager", "build_load_test_model", "LoadStats",
-           "run_recommend_load", "run_recommend_open_loop"]
+           "run_recommend_load", "run_recommend_open_loop",
+           "zipf_picks"]
 
 
 class StaticModelManager(ServingModelManager):
@@ -212,10 +213,23 @@ def run_recommend_load(base_url: str, user_ids: list[str],
                      latencies_ms=np.asarray(latencies))
 
 
+def zipf_picks(rng, n_users: int, n: int, a: float) -> np.ndarray:
+    """Rank-frequency Zipf draw over the user population: user at rank
+    r is drawn with probability ∝ 1/r^a — the hot-user skew real
+    recommendation traffic shows, and the shape the router's exact
+    result cache is built to exploit."""
+    ranks = np.arange(1, n_users + 1, dtype=np.float64)
+    p = 1.0 / np.power(ranks, a)
+    p /= p.sum()
+    return rng.choice(n_users, size=n, p=p)
+
+
 def run_recommend_open_loop(base_url: str, user_ids: list[str],
                             rate_qps: float, duration_sec: float = 6.0,
                             workers: int = 512, how_many: int = 10,
-                            timeout_sec: float = 30.0) -> dict:
+                            timeout_sec: float = 30.0,
+                            zipf_a: float | None = None,
+                            cache_bust: bool = False) -> dict:
     """OPEN-LOOP /recommend driver: requests arrive on an exponential
     inter-arrival schedule at ``rate_qps`` regardless of responses, and
     latency is measured from the SCHEDULED arrival time — so queueing
@@ -223,11 +237,21 @@ def run_recommend_open_loop(base_url: str, user_ids: list[str],
     TrafficUtil.java:63, exponential inter-arrival against live hosts).
     A closed-loop client bounded by transport RTT measures the
     transport; this measures the server.  Saturation shows as achieved
-    qps below offered and a growing scheduled-to-completion tail."""
+    qps below offered and a growing scheduled-to-completion tail.
+
+    ``zipf_a`` skews the user draw hot-user-Zipf instead of uniform;
+    per-response ``X-Oryx-Cache`` verdicts are tallied (with a hit-only
+    latency split) whenever the router stamps them.  ``cache_bust``
+    appends a unique query arg per request so every request is a
+    distinct cache key — the honest way to measure the MISS path
+    against a cache-armed router (uniform draws repeat users within a
+    rung past ~sqrt(2·users) requests, and those accidental hits would
+    inflate a 'cold' cell)."""
     rng = RandomManager.random()
     n = max(1, int(rate_qps * duration_sec))
     arrivals = np.cumsum(rng.exponential(1.0 / rate_qps, n))
-    picks = rng.integers(0, len(user_ids), n)
+    picks = zipf_picks(rng, len(user_ids), n, zipf_a) \
+        if zipf_a else rng.integers(0, len(user_ids), n)
     parsed = urllib.parse.urlparse(base_url)
     host, port = parsed.hostname, parsed.port
     path_prefix = parsed.path.rstrip("/")
@@ -237,6 +261,10 @@ def run_recommend_open_loop(base_url: str, user_ids: list[str],
     # (latency_ms, X-Oryx-Trace id) for sampled responses: lets the
     # harness name the recorded trace behind each worst-p99 request
     traced: list[tuple[float, str]] = []
+    # X-Oryx-Cache verdict tallies + hit-only latencies (the cached-hit
+    # p50 headline); empty when the router does not stamp the header
+    cache_counts: dict[str, int] = {}
+    hit_lat: list[float] = []
     errors = [0]
     lock = threading.Lock()
     next_index = [0]
@@ -254,7 +282,7 @@ def run_recommend_open_loop(base_url: str, user_ids: list[str],
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             rfile = conn.makefile("rb")
 
-        def one(path: str) -> tuple[bool, str | None]:
+        def one(path: str) -> tuple[bool, str | None, str | None]:
             conn.sendall(f"GET {path} HTTP/1.1\r\nHost: a\r\n\r\n"
                          .encode("latin-1"))
             status_line = rfile.readline(65537)
@@ -262,7 +290,7 @@ def run_recommend_open_loop(base_url: str, user_ids: list[str],
                 raise ConnectionError("closed")
             status = int(status_line.split(b" ", 2)[1])
             clen = 0
-            trace = None
+            trace = verdict = None
             while True:
                 h = rfile.readline(65537)
                 if h in (b"\r\n", b"\n", b""):
@@ -271,6 +299,8 @@ def run_recommend_open_loop(base_url: str, user_ids: list[str],
                     clen = int(h[15:])
                 elif h[:13].lower() == b"x-oryx-trace:":
                     trace = h[13:].strip().decode("latin-1")
+                elif h[:13].lower() == b"x-oryx-cache:":
+                    verdict = h[13:].strip().decode("latin-1")
             if clen:
                 remaining = clen
                 while remaining:
@@ -278,7 +308,7 @@ def run_recommend_open_loop(base_url: str, user_ids: list[str],
                     if not got:
                         raise ConnectionError("short body")
                     remaining -= len(got)
-            return status == 200, trace
+            return status == 200, trace, verdict
 
         try:
             while True:
@@ -294,11 +324,18 @@ def run_recommend_open_loop(base_url: str, user_ids: list[str],
                 late = max(0.0, time.perf_counter() - scheduled)
                 path = (f"{path_prefix}/recommend/{user_ids[picks[i]]}"
                         f"?howMany={how_many}")
-                trace = None
+                if cache_bust:
+                    path += f"&cb={i}"
+                trace = verdict = None
+                sent = None
                 try:
                     if conn is None:
                         connect()
-                    ok, trace = one(path)
+                    # stamped AFTER the (re)connect: a hit's recorded
+                    # latency must name the server's cost, not a
+                    # post-error TCP handshake on this worker's socket
+                    sent = time.perf_counter()
+                    ok, trace, verdict = one(path)
                 except Exception:  # noqa: BLE001 — counted as error
                     ok = False
                     if conn is not None:
@@ -316,6 +353,16 @@ def run_recommend_open_loop(base_url: str, user_ids: list[str],
                         done_ts.append(done - t0)
                         if trace:
                             traced.append((ms, trace))
+                        if verdict:
+                            cache_counts[verdict] = \
+                                cache_counts.get(verdict, 0) + 1
+                            if verdict == "hit" and sent is not None:
+                                # send->response latency, NOT schedule
+                                # slip: the cached-hit p50 must name
+                                # the server's cost, not client-pool
+                                # queueing at rates past the cold
+                                # ceiling
+                                hit_lat.append((done - sent) * 1000.0)
                     else:
                         errors[0] += 1
         finally:
@@ -376,11 +423,22 @@ def run_recommend_open_loop(base_url: str, user_ids: list[str],
     # directly attributable (queue-wait vs device-execute vs merge)
     worst = [{"ms": round(ms, 1), "trace": t}
              for ms, t in sorted(traced, reverse=True)[:5]]
+    stamped = sum(cache_counts.values())
+    cache = None
+    if stamped:
+        cache = dict(cache_counts)
+        cache["hit_rate"] = round(
+            cache_counts.get("hit", 0) / stamped, 4)
+        if hit_lat:
+            hl = np.asarray(hit_lat)
+            cache["hit_p50_ms"] = round(float(np.percentile(hl, 50)), 3)
+            cache["hit_p99_ms"] = round(float(np.percentile(hl, 99)), 3)
     return {
         "offered_qps": round(rate_qps, 1),
         "achieved_qps": round(achieved, 1),
         "errors": errors[0],
         "worst_sampled": worst,
+        "cache": cache,
         "p50_ms": round(float(np.percentile(lat, 50)), 1) if len(lat) else None,
         "p95_ms": round(float(np.percentile(lat, 95)), 1) if len(lat) else None,
         # mean time requests spent waiting for a free client slot past

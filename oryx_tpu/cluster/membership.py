@@ -345,6 +345,30 @@ class MembershipRegistry:
             r = self._rr % len(newest)
             return newest[r:] + newest[:r] + older
 
+    def generation_topology(self) -> tuple[int, tuple[int, ...], bool]:
+        """The result cache's epoch: (routed topology, per-shard newest
+        ready generation with -1 for an uncovered shard, and a MIXED
+        flag).  Keying cached answers by the first two means a
+        generation rollout or topology cutover changes the key
+        shard-by-shard as heartbeats flip.  ``mixed`` is True while any
+        shard's replica group spans generations: during that window a
+        hedge may fall back to an older-generation sibling and win, so
+        a complete answer is NOT provably of the newest generation —
+        the cache refuses to serve or store until the group converges
+        (cluster/result_cache.py; the MODEL-publish flush reclaims the
+        previous epoch's bytes)."""
+        with self._lock:
+            of = self._topology_locked()
+            gens = [-1] * of
+            mixed = False
+            for hb in self._live_locked():
+                if hb.ready and hb.of == of and 0 <= hb.shard < of:
+                    prev = gens[hb.shard]
+                    if prev != -1 and prev != hb.generation:
+                        mixed = True
+                    gens[hb.shard] = max(prev, hb.generation)
+            return of, tuple(gens), mixed
+
     def covered_shards(self) -> list[int]:
         with self._lock:
             of = self._topology_locked()

@@ -59,8 +59,10 @@ from ..serving import console
 from ..serving.als import (IDCount, IDValue, how_many_offset,
                            parse_id_value_segments)
 from ..serving.framework import send_input
+from ..kafka.api import KEY_MODEL, KEY_MODEL_REF, KEY_UP
 from .membership import KEY_HEARTBEAT, MembershipRegistry
 from .merge import Row, merge_top_n
+from .result_cache import ResultCache
 from .scatter import ScatterGather, ShardResponse, ShardUnavailable
 from .sharding import shard_of
 
@@ -586,6 +588,32 @@ def _ingest(req: Request):
     return serving_ingest(req)
 
 
+# -- result-cache admin -------------------------------------------------------
+
+def _cache(req: Request) -> "ResultCache":
+    rc = req.context.get("result_cache")
+    if rc is None:
+        raise OryxServingException(
+            404, "result cache disabled (oryx.cluster.cache.enabled / "
+                 "oryx.cluster.coalesce.enabled)")
+    return rc
+
+
+def _cache_get(req: Request):
+    """Operator stats for the exact result cache + coalescer: entry
+    and byte occupancy, hit rate, invalidation/eviction/flush counts,
+    in-flight coalesced scatters (docs/SCALING.md)."""
+    return _cache(req).stats()
+
+
+def _cache_flush(req: Request):
+    """Drop every cached entry (the operator hatch — e.g. after
+    arming a rescorer provider on the replicas, whose output the
+    cache must not outlive)."""
+    rc = _cache(req)
+    return {"flushed": rc.flush("admin"), "stats": rc.stats()}
+
+
 # -- topology admin -----------------------------------------------------------
 
 def _topology_get(req: Request):
@@ -675,6 +703,9 @@ def _metrics(req: Request):
     admission = req.context.get("admission")
     if admission is not None:
         out["cluster"]["admission"] = admission.stats()
+    result_cache = req.context.get("result_cache")
+    if result_cache is not None:
+        out["cluster"]["cache"] = result_cache.stats()
     gauges = registry.gauges_snapshot()
     if gauges:
         out["freshness"] = gauges
@@ -692,24 +723,30 @@ def _error(req: Request):
 ROUTES = [
     # admission=True marks the scatter data plane: when the admission
     # controller measures overload these shed as fast 503 + Retry-After
-    # (cluster/admission.py); health/admin/write endpoints stay open
-    Route("GET", "/recommend/{userID}", _recommend, admission=True),
+    # (cluster/admission.py); health/admin/write endpoints stay open.
+    # cache=True marks the exact-result-cache surface (routes whose
+    # answers have a precise per-user/per-item invalidation key —
+    # cluster/result_cache.py); a hit bypasses the admission gate.
+    Route("GET", "/recommend/{userID}", _recommend, admission=True,
+          cache=True),
     Route("GET", "/recommendToMany/{userIDs:+}", _recommend_to_many,
-          admission=True),
+          admission=True, cache=True),
     Route("GET", "/recommendToAnonymous/{itemIDs:+}",
-          _recommend_to_anonymous, admission=True),
+          _recommend_to_anonymous, admission=True, cache=True),
     Route("GET", "/recommendWithContext/{userID}/{itemIDs:+}",
-          _recommend_with_context, admission=True),
-    Route("GET", "/similarity/{itemIDs:+}", _similarity, admission=True),
+          _recommend_with_context, admission=True, cache=True),
+    Route("GET", "/similarity/{itemIDs:+}", _similarity, admission=True,
+          cache=True),
     Route("GET", "/similarityToItem/{toItemID}/{itemIDs:+}",
-          _similarity_to_item, admission=True),
+          _similarity_to_item, admission=True, cache=True),
     Route("GET", "/estimate/{userID}/{itemIDs:+}", _estimate,
-          admission=True),
+          admission=True, cache=True),
     Route("GET", "/estimateForAnonymous/{toItemID}/{itemIDs:+}",
-          _estimate_for_anonymous, admission=True),
-    Route("GET", "/because/{userID}/{itemID}", _because, admission=True),
+          _estimate_for_anonymous, admission=True, cache=True),
+    Route("GET", "/because/{userID}/{itemID}", _because, admission=True,
+          cache=True),
     Route("GET", "/mostSurprising/{userID}", _most_surprising,
-          admission=True),
+          admission=True, cache=True),
     Route("GET", "/mostActiveUsers", _most_counts, admission=True),
     Route("GET", "/mostPopularItems", _most_counts, admission=True),
     Route("GET", "/popularRepresentativeItems",
@@ -718,7 +755,8 @@ ROUTES = [
     Route("GET", "/allUserIDs", _proxy_any, admission=True),
     Route("GET", "/item/allIDs", _all_item_ids, admission=True),
     Route("GET", "/allItemIDs", _all_item_ids, admission=True),
-    Route("GET", "/knownItems/{userID}", _proxy_any, admission=True),
+    Route("GET", "/knownItems/{userID}", _proxy_any, admission=True,
+          cache=True),
     Route("POST", "/pref/{userID}/{itemID}", _pref_post, mutates=True),
     Route("DELETE", "/pref/{userID}/{itemID}", _pref_delete, mutates=True),
     Route("POST", "/ingest", _ingest, mutates=True),
@@ -735,6 +773,9 @@ ROUTES = [
     # elastic-topology admin: reshard status + target declaration
     Route("GET", "/admin/topology", _topology_get),
     Route("POST", "/admin/topology", _topology_post, mutates=True),
+    # result-cache admin: occupancy/hit-rate stats + the flush hatch
+    Route("GET", "/admin/cache", _cache_get),
+    Route("POST", "/admin/cache/flush", _cache_flush, mutates=True),
     Route("GET", "/error", _error),
     console.console_route("ALS scatter-gather gateway", [
         console.Endpoint("/recommend/{0}", ("userID",)),
@@ -799,6 +840,12 @@ class RouterLayer:
         # uses
         self.metrics.gauge_fn("cluster_queue_wait_ms",
                               self.scatter.cluster_queue_wait_ms)
+        # exact result cache + single-flight coalescing on the scatter
+        # hot path (cluster/result_cache.py; None = both gates off).
+        # Invalidated precisely from the SAME update-topic tap the
+        # membership consumer runs — no extra consumer, no TTLs.
+        self.result_cache = ResultCache.from_config(
+            config, self.metrics, self.membership)
         # SLO burn-rate engine over the router's own exactly-mergeable
         # bucket counters (obs/slo.py; None = disabled).  Evaluated
         # lazily on gauge reads, alert state at /admin/slo, and the
@@ -838,6 +885,7 @@ class RouterLayer:
                 "input_producer": self.input_producer,
                 "admission":
                     self.admission if self.admission.enabled else None,
+                "result_cache": self.result_cache,
                 "slo": self.slo_engine,
                 "events": self.events,
                 "yty_cache": {},
@@ -855,11 +903,24 @@ class RouterLayer:
 
     def _consume_membership(self) -> None:
         broker = resolve_broker(self.update_broker)
+        rc = self.result_cache
+        cutovers_seen = self.membership.topology_cutovers
+
+        tailed_before = [False]
 
         def tail():
+            nonlocal cutovers_seen
             # from the CURRENT end: membership is periodic state, not
             # history — replicas re-announce every interval, so the
-            # registry is complete one heartbeat period after start
+            # registry is complete one heartbeat period after start.
+            # The CACHE's invalidations are one-shot, though: a
+            # resubscribe after a consumer failure skips whatever UP
+            # records went by during the gap, so the restarted tail
+            # flushes the epoch — heartbeats self-heal, evictions
+            # don't.
+            if tailed_before[0] and rc is not None:
+                rc.flush("tap-resubscribe")
+            tailed_before[0] = True
             for km in broker.consume(self.update_topic,
                                      from_beginning=False,
                                      stop=self._stop):
@@ -869,6 +930,23 @@ class RouterLayer:
                         # misconfigured i/N replica whose ring does not
                         # exist here — countable evidence, never merged
                         self.metrics.inc("stale_topology_heartbeats")
+                    if rc is not None:
+                        # a topology cutover retires a whole ring: its
+                        # entries can never be served (the topology is
+                        # in every key) — reclaim their bytes now
+                        cut = self.membership.topology_cutovers
+                        if cut != cutovers_seen:
+                            cutovers_seen = cut
+                            rc.flush("topology-cutover")
+                elif rc is not None:
+                    # the result cache's invalidation feed rides the
+                    # SAME tap: UP records evict exactly the touched
+                    # user's/item's keys, a model publish flushes the
+                    # epoch (the stale-feed safety valve)
+                    if km.key == KEY_UP:
+                        rc.note_up(km.message)
+                    elif km.key in (KEY_MODEL, KEY_MODEL_REF):
+                        rc.note_generation_publish()
 
         run_with_resubscribe(tail, stop=self._stop,
                              what="router membership consumer", log=_log)

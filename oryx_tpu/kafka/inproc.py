@@ -165,6 +165,15 @@ class _Partition:
                                                      headers]
         record = (json.dumps(rec) + "\n").encode("utf-8")
         with self._lock:
+            if self.persist_path is not None and self._fd is None:
+                # a durable broker that was close()d but handed back by
+                # the process-local registry: re-open the log rather
+                # than ack the append into memory only — an in-memory
+                # append on a persisted partition is invisible to every
+                # other process, i.e. acked-but-lost
+                self._fd = os.open(self.persist_path,
+                                   os.O_APPEND | os.O_CREAT | os.O_WRONLY,
+                                   0o644)
             if self._fd is not None:
                 # the file is the source of truth: write, then re-read
                 # up to and past our record so in-memory offsets always

@@ -29,8 +29,8 @@ from typing import Any, Callable, NamedTuple
 from ..api.serving import HasCSV, OryxServingException
 from ..resilience.policy import Deadline, DeadlineExceeded
 
-__all__ = ["Route", "Request", "HttpApp", "json_or_csv", "HtmlResponse",
-           "TextResponse", "render_error_page"]
+__all__ = ["Route", "Request", "HttpApp", "json_or_csv", "wants_csv",
+           "HtmlResponse", "TextResponse", "render_error_page"]
 
 
 class HtmlResponse:
@@ -95,6 +95,11 @@ class Route(NamedTuple):
     # instead of queueing to collapse.  Control/health endpoints stay
     # un-gated so operators can see INTO an overloaded process.
     admission: bool = False
+    # exact-result-cache eligible (when a result cache is in the app
+    # context — the cluster router's hot path): complete 200s are
+    # served from preserialized bytes and concurrent identical misses
+    # coalesce onto one in-flight computation (cluster/result_cache.py)
+    cache: bool = False
 
 
 class Request(NamedTuple):
@@ -138,16 +143,23 @@ def _compile(pattern: str) -> re.Pattern:
     return re.compile("^/" + "/".join(out) + "$")
 
 
+def wants_csv(accept: str) -> bool:
+    """The CSV-vs-JSON negotiation predicate, shared with the result
+    cache so cached variants are keyed exactly as cold renders are."""
+    return "text/csv" in accept or (
+        "text/plain" in accept and "json" not in accept)
+
+
 def json_or_csv(value: Any, accept: str) -> tuple[bytes, str]:
-    """Render a response honoring Accept: JSON by default, CSV lines when
-    text/csv is asked for (reference: CSVMessageBodyWriter)."""
+    """Render a response honoring Accept: JSON by default (compact —
+    no whitespace; at top-N row counts the separators are a measurable
+    fraction of every body), CSV lines when text/csv is asked for
+    (reference: CSVMessageBodyWriter)."""
     if isinstance(value, HtmlResponse):
         return value.html.encode(), "text/html; charset=utf-8"
     if isinstance(value, TextResponse):
         return value.text.encode(), value.content_type
-    wants_csv = "text/csv" in accept or (
-        "text/plain" in accept and "json" not in accept)
-    if wants_csv:
+    if wants_csv(accept):
         if isinstance(value, (list, tuple)):
             lines = []
             for item in value:
@@ -167,7 +179,7 @@ def json_or_csv(value: Any, accept: str) -> tuple[bytes, str]:
     # default-callback protocol costs ~3x per element)
     if isinstance(value, list) and value \
             and hasattr(type(value[0]), "to_json_fragment"):
-        return ("[" + ", ".join(v.to_json_fragment() for v in value)
+        return ("[" + ",".join(v.to_json_fragment() for v in value)
                 + "]").encode(), "application/json"
 
     def _default(o):
@@ -175,7 +187,30 @@ def json_or_csv(value: Any, accept: str) -> tuple[bytes, str]:
             return o.__dict__
         raise TypeError(type(o).__name__)
 
-    return json.dumps(value, default=_default).encode(), "application/json"
+    return json.dumps(value, default=_default,
+                      separators=(",", ":")).encode(), "application/json"
+
+
+def _split_result(result) -> tuple[int, Any, dict]:
+    """Normalize handler results: value | (status, value) | (status,
+    value, headers) — the 3-form lets resources attach response headers
+    (the cluster gateway's X-Oryx-Partial degraded-answer marker)."""
+    if isinstance(result, tuple) and len(result) == 3 \
+            and isinstance(result[0], int) \
+            and isinstance(result[2], dict):
+        return result
+    if isinstance(result, tuple) and len(result) == 2 \
+            and isinstance(result[0], int):
+        return result[0], result[1], {}
+    return 200, result, {}
+
+
+def _render_kind(value: Any, kind: str) -> tuple[bytes, str]:
+    """The result cache's canonical serializer: one fixed Accept per
+    variant kind, through the SAME json_or_csv a cold response renders
+    with — cached bytes are cold bytes by construction."""
+    return json_or_csv(value,
+                       "text/csv" if kind == "csv" else "application/json")
 
 
 class HttpApp:
@@ -204,6 +239,10 @@ class HttpApp:
         # optional admission controller (cluster/admission.py): gates
         # routes marked admission=True; absent = no per-request cost
         self.admission = context.get("admission")
+        # optional exact result cache + single-flight coalescer
+        # (cluster/result_cache.py): serves routes marked cache=True
+        # from preserialized bytes; absent = no per-request cost
+        self.result_cache = context.get("result_cache")
         self.user_name = user_name
         self.password = password
         self.realm = "Oryx"
@@ -380,10 +419,56 @@ class HttpApp:
                 self._send_error(handler, 403, "endpoint is read-only")
                 self._drain_body(handler)
                 return
+            probe = flight = deadline = None
+            rc = self.result_cache
+            if route.cache and rc is not None:
+                # the cache hot path: a hit serves preserialized bytes
+                # BEFORE the admission gate (it costs no device or
+                # queue time — under overload the cluster degrades to
+                # "cached answers + fast 503s" instead of just 503s)
+                probe = rc.probe(route.pattern, path, query,
+                                 m.groupdict())
+            if probe is not None:
+                if self.tracer is not None:
+                    with self.tracer.span("router.cache_lookup") as sp:
+                        entry = rc.lookup(probe)
+                        sp.set_attr("cache", "hit" if entry is not None
+                                    else "miss")
+                else:
+                    entry = rc.lookup(probe)
+                if entry is not None:
+                    self._send_entry(handler, entry, "hit",
+                                     method == "HEAD")
+                    self._drain_body(handler)
+                    return
+                # single-flight join ALSO before the admission gate: a
+                # coalesced follower does no scatter work and must not
+                # park on the leader while holding an inflight slot —
+                # a herd on one cold key would otherwise consume
+                # herd-sized admission capacity for one scatter's work
+                deadline = self._deadline(handler)
+                try:
+                    kind, got = rc.begin_flight(probe, deadline)
+                except Exception as e:  # noqa: BLE001 — chaos seam
+                    self._send_error(handler, 500,
+                                     f"{type(e).__name__}: {e}")
+                    self._drain_body(handler)
+                    return
+                if kind == "coalesced":
+                    self._send_entry(handler, got, "coalesced",
+                                     method == "HEAD")
+                    self._drain_body(handler)
+                    return
+                if kind == "lead":
+                    flight = got
             admitted = False
             if route.admission and self.admission is not None:
                 ok, retry_after = self.admission.try_acquire()
                 if not ok:
+                    if flight is not None:
+                        # a shed leader wakes its followers to their
+                        # own (equally shed, equally fast) verdicts
+                        rc.finish_flight(flight, None)
                     # measured overload: degrade to a FAST 503 the
                     # client can back off on, instead of queueing the
                     # request into the collapse it would deepen
@@ -395,7 +480,7 @@ class HttpApp:
                 admitted = True
             try:
                 self._dispatch_route(handler, route, path, m, query,
-                                     method)
+                                     method, probe, flight, deadline)
             finally:
                 if admitted:
                     self.admission.release()
@@ -407,60 +492,106 @@ class HttpApp:
         self._drain_body(handler)
 
     def _dispatch_route(self, handler, route, path, m, query,
-                        method) -> None:
+                        method, probe=None, flight=None,
+                        deadline=None) -> None:
+        published = None  # the entry handed to coalesced followers
         try:
-            length = int(handler.headers.get("Content-Length") or 0)
-        except ValueError:
-            if hasattr(handler, "_close"):
-                handler._close = True  # framing unknown: don't reuse
-            self._send_error(handler, 400, "bad Content-Length")
-            return
-        body = handler.rfile.read(length) if length > 0 else b""
-        if handler.headers.get("Content-Encoding", "") == "gzip" and body:
             try:
-                body = gzip.decompress(body)
-            except (gzip.BadGzipFile, OSError, EOFError):
-                self._send_error(handler, 400,
-                                 "Content-Encoding gzip but body is not")
+                length = int(handler.headers.get("Content-Length") or 0)
+            except ValueError:
+                if hasattr(handler, "_close"):
+                    handler._close = True  # framing unknown: don't reuse
+                self._send_error(handler, 400, "bad Content-Length")
                 return
-        req = Request(method, path, m.groupdict(), query, body,
-                      dict(handler.headers), self.context,
-                      deadline=self._deadline(handler))
-        try:
-            result = route.handler(req)
-        except OryxServingException as e:
-            self._send_error(handler, e.status, str(e))
-            return
-        except DeadlineExceeded as e:
-            # the request's time budget ran out while queued or in
-            # flight: shed it (the lambda 503 contract) rather than
-            # report a server fault
-            self._send_error(handler, 503, str(e))
-            return
-        except (ValueError, KeyError) as e:
-            self._send_error(handler, 400, f"bad request: {e}")
-            return
-        except Exception as e:  # noqa: BLE001 — uniform 500 error page
-            self._send_error(handler, 500, f"{type(e).__name__}: {e}")
-            return
-        self._send(handler, result, method == "HEAD",
-                   handler.headers.get("Accept", ""),
-                   "gzip" in handler.headers.get("Accept-Encoding", ""))
+            body = handler.rfile.read(length) if length > 0 else b""
+            if handler.headers.get("Content-Encoding", "") == "gzip" \
+                    and body:
+                try:
+                    body = gzip.decompress(body)
+                except (gzip.BadGzipFile, OSError, EOFError):
+                    self._send_error(
+                        handler, 400,
+                        "Content-Encoding gzip but body is not")
+                    return
+            req = Request(method, path, m.groupdict(), query, body,
+                          dict(handler.headers), self.context,
+                          deadline=deadline if probe is not None
+                          else self._deadline(handler))
+            try:
+                result = route.handler(req)
+            except OryxServingException as e:
+                self._send_error(handler, e.status, str(e))
+                return
+            except DeadlineExceeded as e:
+                # the request's time budget ran out while queued or in
+                # flight: shed it (the lambda 503 contract) rather than
+                # report a server fault
+                self._send_error(handler, 503, str(e))
+                return
+            except (ValueError, KeyError) as e:
+                self._send_error(handler, 400, f"bad request: {e}")
+                return
+            except Exception as e:  # noqa: BLE001 — uniform 500 page
+                self._send_error(handler, 500, f"{type(e).__name__}: {e}")
+                return
+            if probe is not None:
+                status, value, extra = _split_result(result)
+                if not isinstance(value, (HtmlResponse, TextResponse)):
+                    published = self.result_cache.store(
+                        probe, status, value, extra, _render_kind)
+                if flight is not None:
+                    # wake the followers BEFORE writing our own
+                    # response: a slow-reading leader client must not
+                    # hold the herd hostage on its socket (the finally
+                    # below is idempotent and covers error paths)
+                    self.result_cache.finish_flight(flight, published)
+                if published is not None and not extra:
+                    # serve THROUGH the entry: a future hit is
+                    # byte-identical to this miss by construction
+                    self._send_entry(handler, published, "miss",
+                                     method == "HEAD")
+                    return
+                # uncacheable result (error/partial/rescorer): still
+                # stamp the verdict so clients can tell
+                result = (status, value,
+                          {**extra, "X-Oryx-Cache": "miss"})
+            self._send(handler, result, method == "HEAD",
+                       handler.headers.get("Accept", ""),
+                       "gzip" in handler.headers.get("Accept-Encoding",
+                                                     ""))
+        finally:
+            # the flight was opened in _handle (before the admission
+            # gate): EVERY exit — framing errors included — must wake
+            # the followers, or they park out their whole wait
+            if flight is not None:
+                self.result_cache.finish_flight(flight, published)
+
+    def _send_entry(self, handler, entry, verdict: str,
+                    head_only: bool) -> None:
+        """Serve a cached/coalesced entry: preserialized bytes, no
+        json_or_csv, no gzip recompression (the stored gzip variant is
+        reused as-is), stamped ``X-Oryx-Cache``."""
+        accept = handler.headers.get("Accept", "")
+        gzip_ok = "gzip" in handler.headers.get("Accept-Encoding", "")
+        payload, ctype, gzipped = self.result_cache.render(
+            entry, wants_csv(accept), gzip_ok, _render_kind)
+        handler._oryx_status = 200
+        handler.send_response(200)
+        trace_id = getattr(handler, "_oryx_trace", None)
+        if trace_id:
+            handler.send_header("X-Oryx-Trace", trace_id)
+        handler.send_header("X-Oryx-Cache", verdict)
+        handler.send_header("Content-Type", ctype)
+        if gzipped:
+            handler.send_header("Content-Encoding", "gzip")
+        handler.send_header("Content-Length", str(len(payload)))
+        handler.end_headers()
+        if not head_only:
+            handler.wfile.write(payload)
 
     def _send(self, handler, result, head_only: bool, accept: str,
               gzip_ok: bool) -> None:
-        status = 200
-        extra_headers: dict[str, str] = {}
-        # handler results: value | (status, value) | (status, value,
-        # headers) — the 3-form lets resources attach response headers
-        # (the cluster gateway's X-Oryx-Partial degraded-answer marker)
-        if isinstance(result, tuple) and len(result) == 3 \
-                and isinstance(result[0], int) \
-                and isinstance(result[2], dict):
-            status, result, extra_headers = result
-        elif isinstance(result, tuple) and len(result) == 2 \
-                and isinstance(result[0], int):
-            status, result = result
+        status, result, extra_headers = _split_result(result)
         trace_id = getattr(handler, "_oryx_trace", None)
         if result is None:
             status = status if status != 200 else 204

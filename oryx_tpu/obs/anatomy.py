@@ -38,9 +38,9 @@ __all__ = ["STAGES", "analyze_trace", "tail_report"]
 
 # the stage taxonomy, in display order; linted against the
 # docs/OBSERVABILITY.md stage table
-STAGES = ("router.dispatch", "scatter.wait", "serving.request",
-          "serving.queue_wait", "serving.device_execute",
-          "router.merge", "untraced")
+STAGES = ("router.dispatch", "router.cache_lookup", "scatter.wait",
+          "serving.request", "serving.queue_wait",
+          "serving.device_execute", "router.merge", "untraced")
 
 
 def _dur(span: Mapping | None) -> float:
@@ -129,10 +129,20 @@ def analyze_trace(spans: Iterable[Mapping]) -> dict | None:
                         for s in children)
             lead = first - float(root.get("start_ms") or 0.0)
         budget = max(0.0, total - scatter - merge)
-        stages["router.dispatch"] = min(max(0.0, lead), budget)
+        # the result-cache probe (a root-child span, present on router
+        # hits AND misses when the cache is armed) sits inside the
+        # pre-scatter window: carve it out of the dispatch lead so a
+        # cache-served request's time is attributed to the lookup, not
+        # smeared into untraced residue
+        lookup = min(budget, sum(_dur(s) for s in
+                                 _children(spans, root_id,
+                                           "router.cache_lookup")))
+        stages["router.cache_lookup"] = lookup
+        stages["router.dispatch"] = min(max(0.0, lead - lookup),
+                                        budget - lookup)
         # whatever no span accounts for (post-merge serialization,
         # hedge bookkeeping, gaps): the honest remainder
-        stages["untraced"] = budget - stages["router.dispatch"]
+        stages["untraced"] = budget - lookup - stages["router.dispatch"]
     else:
         # single-node (or replica-local) request: the batcher split
         # hangs directly under the serving.request root; the root's

@@ -59,8 +59,9 @@ class IDValue:
         # json.dumps' spelling, which repr would break)
         v = float(self.value)
         if not math.isfinite(v):
-            return json.dumps({"id": self.id, "value": v})
-        return f'{{"id": {json.dumps(self.id)}, "value": {v!r}}}'
+            return json.dumps({"id": self.id, "value": v},
+                              separators=(",", ":"))
+        return f'{{"id":{json.dumps(self.id)},"value":{v!r}}}'
 
 
 @dataclasses.dataclass
@@ -74,7 +75,7 @@ class IDCount:
         return f"{self.id},{self.count}"
 
     def to_json_fragment(self) -> str:
-        return f'{{"id": {json.dumps(self.id)}, "count": {int(self.count)}}}'
+        return f'{{"id":{json.dumps(self.id)},"count":{int(self.count)}}}'
 
 
 def _als_model(req: Request) -> ALSServingModel:

@@ -289,6 +289,47 @@ def test_check_regression_gateway_new_replica_group_cell_not_gated(
     assert not report["missing_cells"]
 
 
+def test_check_regression_gateway_zipf_cells_gate_independently(
+        tmp_path, capsys):
+    """The r11 hot-user Zipf rung gates as its own pseudo-cell: a
+    result-cache regression (zipf qps collapsing back toward the cold
+    ceiling) fails the gate even when the cold cell held."""
+    prev = _gateway_doc([(50, 65536, 1, 100.0)])
+    prev["rows"][0]["zipf"] = {"a": 1.2,
+                               "open_loop_sustained_qps": 900.0}
+    cur = _gateway_doc([(50, 65536, 1, 101.0)])
+    cur["rows"][0]["zipf"] = {"a": 1.2,
+                              "open_loop_sustained_qps": 300.0}
+    rc = cr.main(["--kind", "gateway",
+                  "--previous", _write(tmp_path,
+                                       "BENCH_GATEWAY_r09.json", prev),
+                  "--current", _write(tmp_path,
+                                      "BENCH_GATEWAY_r11.json", cur)])
+    assert rc == 1
+    report = json.loads(capsys.readouterr().out)
+    assert [c["cell"] for c in report["regressions"]] == \
+        ["50f/0.065536M/1rep/zipf"]
+
+
+def test_check_regression_gateway_zipf_cell_back_compat(tmp_path,
+                                                        capsys):
+    """Pre-cache artifacts carry no zipf rung: the new pseudo-cell is
+    reported as new and never gated against the cold baseline."""
+    prev = _gateway_doc([(50, 65536, 1, 100.0)])           # r09 shape
+    cur = _gateway_doc([(50, 65536, 1, 99.0)])
+    cur["rows"][0]["zipf"] = {"a": 1.2,
+                              "open_loop_sustained_qps": 800.0}
+    rc = cr.main(["--kind", "gateway",
+                  "--previous", _write(tmp_path,
+                                       "BENCH_GATEWAY_r09.json", prev),
+                  "--current", _write(tmp_path,
+                                      "BENCH_GATEWAY_r11.json", cur)])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["new_cells"] == ["(50, 65536, 1, 1, 'zipf')"]
+    assert not report["regressions"]
+
+
 def test_check_regression_gateway_discovers_rounds_and_skips_cross_backend(
         tmp_path, capsys):
     _write(tmp_path, "BENCH_GATEWAY_r07.json",

@@ -1,0 +1,568 @@
+"""Result-cache integration tests (ISSUE 8 acceptance): a 2-shard
+cluster, a cache+coalesce-armed router, an UNcached reference router
+scattering the SAME replicas, and a speed layer driving real fold-ins
+through the real update topic.  Proves
+
+1. exactness: cached (hit) and coalesced responses are BYTE-IDENTICAL
+   — JSON and CSV, gzip round-trip, tie and offset edges, randomized
+   args — to a cold scatter;
+2. the zero-stale guarantee: a ``/pref`` fold-in for user U followed
+   by ``/recommend/U`` never serves the pre-fold-in cached rows once
+   the invalidation tap has the UP record, while user V's entry
+   SURVIVES (precise, not epoch, invalidation);
+3. hits bypass the admission gate (overload degrades to "cached
+   answers + fast 503s");
+4. the chaos points: ``router-cache-stale-feed`` (stalled tap → stale
+   hits, counted, rescued by the generation-publish epoch flush) and
+   ``router-coalesce-leader-death`` (dead leader → followers re-issue,
+   no hang); partial answers are never cached.
+
+Marker: chaos (in the tier-1 budget).
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from oryx_tpu.cluster.router import RouterLayer
+from oryx_tpu.common.config import from_dict
+from oryx_tpu.kafka.inproc import get_broker
+from oryx_tpu.lambda_rt.serving import ServingLayer
+from oryx_tpu.lambda_rt.speed import SpeedLayer
+from oryx_tpu.resilience import faults
+from oryx_tpu.resilience.policy import Deadline
+
+pytestmark = pytest.mark.chaos
+
+BROKER = "cache-it"
+UPDATE_TOPIC = "KUp"
+INPUT_TOPIC = "KIn"
+FEATURES = 3
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _config(tmp_path, **extra):
+    overlay = {
+        "oryx.id": "cache-it",
+        "oryx.input-topic.broker": f"memory://{BROKER}",
+        "oryx.input-topic.partitions": 1,
+        "oryx.input-topic.message.topic": INPUT_TOPIC,
+        "oryx.update-topic.broker": f"memory://{BROKER}",
+        "oryx.update-topic.message.topic": UPDATE_TOPIC,
+        "oryx.speed.model-manager-class":
+            "oryx_tpu.app.als.speed.ALSSpeedModelManager",
+        "oryx.serving.model-manager-class":
+            "oryx_tpu.app.als.serving_manager.ALSServingModelManager",
+        "oryx.serving.application-resources": "oryx_tpu.serving.als",
+        "oryx.als.implicit": True,
+        "oryx.als.hyperparams.features": FEATURES,
+        # only the explicit run_one_micro_batch() hook folds input —
+        # the IT controls exactly when the fold-in happens
+        "oryx.speed.streaming.generation-interval-sec": 100000,
+        "oryx.cluster.heartbeat-interval-ms": 60,
+        "oryx.cluster.heartbeat-ttl-ms": 400,
+        "oryx.cluster.hedge-after-ms": 50,
+        "oryx.cluster.shard-timeout-ms": 5000,
+        "oryx.resilience.retry.max-attempts": 2,
+        "oryx.resilience.retry.initial-backoff-ms": 1,
+        "oryx.resilience.retry.max-backoff-ms": 2,
+    }
+    overlay.update(extra)
+    return from_dict(overlay)
+
+
+def _model_doc():
+    from oryx_tpu.common import pmml as pmml_io
+    users = [f"cu{j}" for j in range(6)]
+    items = [f"ci{j}" for j in range(14)]
+    doc = pmml_io.build_skeleton_pmml()
+    pmml_io.add_extension(doc, "features", FEATURES)
+    pmml_io.add_extension(doc, "implicit", True)
+    pmml_io.add_extension_content(doc, "XIDs", users)
+    pmml_io.add_extension_content(doc, "YIDs", items)
+    return users, items, pmml_io.to_string(doc)
+
+
+def _publish_model(broker):
+    """Synthetic MODEL + UP replay with EXACT ties: ci10/ci11/ci12
+    share one vector, so any top-N window straddling them exercises
+    the ordinal tie-break in both the cold and cached renders."""
+    from oryx_tpu.kafka.api import KEY_MODEL, KEY_UP
+    users, items, doc = _model_doc()
+    broker.send(UPDATE_TOPIC, KEY_MODEL, doc)
+    rng = np.random.default_rng(17)
+    tied = [float(x) for x in rng.standard_normal(FEATURES)]
+    for iid in items:
+        vec = tied if iid in ("ci10", "ci11", "ci12") \
+            else [float(x) for x in rng.standard_normal(FEATURES)]
+        broker.send(UPDATE_TOPIC, KEY_UP, json.dumps(["Y", iid, vec]))
+    for uid in users:
+        broker.send(UPDATE_TOPIC, KEY_UP, json.dumps(
+            ["X", uid, [float(x) for x in rng.standard_normal(FEATURES)],
+             []]))
+    return users, items
+
+
+def _raw_get(port, path, headers=None, timeout=15):
+    """(status, headers, raw body bytes) — byte-identity assertions
+    must see the wire bytes, not a parsed view."""
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}",
+                                 headers=headers or {})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, dict(r.headers), r.read()
+
+
+def _await(predicate, what, timeout=30.0):
+    deadline = Deadline.after(timeout)
+    while not deadline.expired:
+        try:
+            if predicate():
+                return
+        except (urllib.error.HTTPError, urllib.error.URLError, OSError):
+            pass
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _cache_stats(router):
+    return json.loads(_raw_get(router.port, "/admin/cache")[2])
+
+
+def _flush(router):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{router.port}/admin/cache/flush",
+        data=b"", method="POST")
+    with urllib.request.urlopen(req, timeout=15) as r:
+        return json.loads(r.read())
+
+
+def _foldable_item(cluster, uid):
+    """An item whose current estimated strength leaves the implicit
+    fold-in room to move: computeTargetQui returns NaN ("no change")
+    for a positive event when the estimate is already >= 1, so a test
+    that needs the fold-in to CHANGE the user's vector must pick a
+    pair below that ceiling."""
+    cold = cluster["cold"]
+    path = f"/estimate/{uid}/" + "/".join(cluster["items"])
+    vals = json.loads(_raw_get(cold.port, path)[2])
+    for d in sorted(vals, key=lambda d: d["value"]):
+        if 0.0 <= d["value"] < 0.8:
+            return d["id"]
+    return min(vals, key=lambda d: abs(d["value"]))["id"]
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    """2 shards + cache-armed router + UNcached reference router over
+    the same replicas + a speed layer for real fold-ins."""
+    tmp_path = tmp_path_factory.mktemp("cache-it")
+    broker = get_broker(BROKER)
+    users, items = _publish_model(broker)
+
+    def cfg_fn(extra=None):
+        return _config(tmp_path, **(extra or {}))
+
+    replicas = []
+    for s in range(2):
+        layer = ServingLayer(cfg_fn({
+            "oryx.cluster.enabled": True,
+            "oryx.cluster.shard": f"{s}/2"}), port=0)
+        layer.start()
+        replicas.append(layer)
+    cached = RouterLayer(cfg_fn({
+        "oryx.cluster.cache.enabled": True,
+        "oryx.cluster.coalesce.enabled": True}), port=0)
+    cached.start()
+    cold = RouterLayer(cfg_fn(), port=0)
+    cold.start()
+    speed = SpeedLayer(cfg_fn())
+    speed.start()
+
+    def ready(router):
+        return _raw_get(router.port, "/ready")[0] in (200, 204)
+
+    def fully_loaded(layer):
+        # /ready fires at the 0.8 load gate with the user store still
+        # filling (items stream first); the IT drives the LAST users
+        # in the replay, so wait for the complete model
+        meta = json.loads(_raw_get(layer.port, "/shard/meta")[2])
+        return meta.get("users", 0) >= len(users)
+
+    _await(lambda: ready(cached), "cached router readiness")
+    _await(lambda: ready(cold), "cold router readiness")
+    _await(lambda: all(fully_loaded(r) for r in replicas),
+           "full replica replay")
+    _await(lambda: (m := speed.model_manager.model) is not None
+           and m.get_fraction_loaded() >= 0.8, "speed model")
+    yield {"cfg_fn": cfg_fn, "replicas": replicas, "cached": cached,
+           "cold": cold, "speed": speed, "broker": broker,
+           "users": users, "items": items}
+    for layer in replicas + [cached, cold, speed]:
+        try:
+            layer.close()
+        except Exception:  # noqa: BLE001 — teardown best effort
+            pass
+
+
+# -- 1. exactness -------------------------------------------------------------
+
+def _verdict(headers):
+    return headers.get("X-Oryx-Cache")
+
+
+def test_hit_and_miss_are_byte_identical_to_a_cold_scatter(cluster):
+    cached, cold = cluster["cached"], cluster["cold"]
+    _flush(cached)
+    for uid in cluster["users"][:3]:
+        for qs in ("?howMany=5", "?howMany=10&offset=3",
+                   "?howMany=4&considerKnownItems=true"):
+            path = f"/recommend/{uid}{qs}"
+            _, ch, cold_body = _raw_get(cold.port, path)
+            assert _verdict(ch) is None  # uncached router: no stamp
+            s1, h1, miss_body = _raw_get(cached.port, path)
+            s2, h2, hit_body = _raw_get(cached.port, path)
+            assert (s1, s2) == (200, 200)
+            assert _verdict(h1) == "miss" and _verdict(h2) == "hit"
+            assert miss_body == cold_body == hit_body, path
+
+
+def test_csv_variant_is_byte_identical_and_rendered_once(cluster):
+    cached, cold = cluster["cached"], cluster["cold"]
+    uid = cluster["users"][0]
+    path = f"/recommend/{uid}?howMany=6"
+    hdr = {"Accept": "text/csv"}
+    _, _, cold_csv = _raw_get(cold.port, path, headers=hdr)
+    _raw_get(cached.port, path)  # prime via the JSON form
+    _, h, csv1 = _raw_get(cached.port, path, headers=hdr)
+    assert _verdict(h) == "hit"
+    assert csv1 == cold_csv
+    # JSON and CSV verdicts come from ONE entry (same key, two
+    # variants) — the second CSV read reuses the rendered bytes
+    _, h2, csv2 = _raw_get(cached.port, path, headers=hdr)
+    assert _verdict(h2) == "hit" and csv2 == csv1
+
+
+def test_gzip_hit_skips_recompression_and_round_trips(cluster):
+    cached, cold = cluster["cached"], cluster["cold"]
+    uid = cluster["users"][1]
+    # a body comfortably past the 256-byte gzip threshold
+    path = f"/recommend/{uid}?howMany=14&considerKnownItems=true"
+    hdr = {"Accept-Encoding": "gzip"}
+    _, _, cold_gz = _raw_get(cold.port, path, headers=hdr)
+    _raw_get(cached.port, path)
+    _, h, gz1 = _raw_get(cached.port, path, headers=hdr)
+    assert _verdict(h) == "hit"
+    assert h.get("Content-Encoding") == "gzip"
+    assert gzip.decompress(gz1) == gzip.decompress(cold_gz)
+    # cached gzip bytes are deterministic (mtime pinned): stored once,
+    # re-served verbatim
+    _, _, gz2 = _raw_get(cached.port, path, headers=hdr)
+    assert gz2 == gz1
+
+
+def test_exactness_property_random_args_and_tie_offsets(cluster):
+    """Randomized (user, howMany, offset) sweep, biased toward windows
+    straddling the ci10/ci11/ci12 exact-tie group: every cached answer
+    byte-identical to the cold scatter, JSON and CSV."""
+    cached, cold = cluster["cached"], cluster["cold"]
+    _flush(cached)
+    rng = np.random.default_rng(23)
+    users = cluster["users"]
+    for _ in range(25):
+        uid = users[int(rng.integers(0, len(users)))]
+        how_many = int(rng.integers(1, 16))
+        offset = int(rng.integers(0, 12))
+        path = (f"/recommend/{uid}?howMany={how_many}"
+                f"&offset={offset}&considerKnownItems=true")
+        accept = {"Accept": "text/csv"} if rng.random() < 0.4 else None
+        _, _, cold_body = _raw_get(cold.port, path, headers=accept)
+        _, h1, b1 = _raw_get(cached.port, path, headers=accept)
+        _, h2, b2 = _raw_get(cached.port, path, headers=accept)
+        assert b1 == cold_body == b2, path
+        assert _verdict(h2) == "hit", path
+
+
+def test_wider_cacheable_surface_is_byte_identical(cluster):
+    cached, cold = cluster["cached"], cluster["cold"]
+    uid, items = cluster["users"][0], cluster["items"]
+    i1, i2 = items[0], items[1]
+    for path in (f"/similarity/{i1}/{i2}?howMany=5",
+                 f"/similarityToItem/{i1}/{i2}/{items[2]}",
+                 f"/estimate/{uid}/{i1}/{i2}",
+                 f"/because/{uid}/{i1}?howMany=4",
+                 f"/mostSurprising/{uid}",
+                 f"/knownItems/{uid}",
+                 f"/recommendToMany/{uid}/{cluster['users'][1]}",
+                 f"/recommendToAnonymous/{i1}=2.0/{i2}",
+                 f"/recommendWithContext/{uid}/{i1}=1.5",
+                 f"/estimateForAnonymous/{i1}/{i2}=0.5"):
+        _, _, cold_body = _raw_get(cold.port, path)
+        _, h1, b1 = _raw_get(cached.port, path)
+        _, h2, b2 = _raw_get(cached.port, path)
+        assert b1 == cold_body == b2, path
+        assert _verdict(h1) in ("miss", "hit")
+        assert _verdict(h2) == "hit", path
+
+
+def test_rescorer_params_are_never_cached(cluster):
+    cached = cluster["cached"]
+    uid = cluster["users"][2]
+    path = f"/recommend/{uid}?howMany=3&rescorerParams=x"
+    for _ in range(2):
+        _, h, _ = _raw_get(cached.port, path)
+        assert _verdict(h) is None  # not even stamped: uncacheable
+
+
+def test_coalesced_burst_collapses_to_one_scatter(cluster):
+    cached, cold = cluster["cached"], cluster["cold"]
+    _flush(cached)
+    uid = cluster["users"][3]
+    path = f"/recommend/{uid}?howMany=7"
+    _, _, cold_body = _raw_get(cold.port, path)
+    before = _cache_stats(cached)["coalesced_requests"]
+    results = []
+    barrier = threading.Barrier(8)
+
+    def one():
+        barrier.wait()
+        s, h, b = _raw_get(cached.port, path, timeout=30)
+        results.append((s, _verdict(h), b))
+
+    threads = [threading.Thread(target=one) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30.0)
+    assert len(results) == 8
+    assert all(s == 200 and b == cold_body for s, _, b in results)
+    verdicts = {v for _, v, _ in results}
+    assert verdicts <= {"miss", "coalesced", "hit"}
+    # at least one follower latched onto the leader's scatter (the
+    # rest may have arrived after completion and hit the stored entry)
+    after = _cache_stats(cached)
+    assert after["coalesced_requests"] + after["hits"] > before
+
+
+# -- 2. the zero-stale guarantee ----------------------------------------------
+
+def test_fold_in_evicts_touched_user_and_spares_the_rest(cluster):
+    cached, cold, speed = (cluster["cached"], cluster["cold"],
+                           cluster["speed"])
+    u, v = cluster["users"][4], cluster["users"][5]
+    item = _foldable_item(cluster, u)
+    _flush(cached)
+    pu = f"/recommend/{u}?howMany=8"
+    pv = f"/recommend/{v}?howMany=8"
+    _, _, u_before = _raw_get(cached.port, pu)   # prime U
+    _, _, v_before = _raw_get(cached.port, pv)   # prime V
+    assert _verdict(_raw_get(cached.port, pu)[1]) == "hit"
+    inval_before = _cache_stats(cached)["invalidations"]
+
+    # the real write path: /pref through the router -> input topic ->
+    # speed micro-batch -> UP fold-in on the update topic
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{cached.port}/pref/{u}/{item}", data=b"5.0",
+        method="POST")
+    with urllib.request.urlopen(req, timeout=15) as r:
+        assert r.status in (200, 204)
+    speed.run_one_micro_batch()
+
+    # wait until BOTH consumers of the totally ordered topic are
+    # there: the replicas (the cold answer moves) and the router's
+    # invalidation tap (the counter moves)
+    _await(lambda: _raw_get(cold.port, pu)[2] != u_before,
+           "replicas absorbed the fold-in")
+    _await(lambda: _cache_stats(cached)["invalidations"] > inval_before,
+           "invalidation tap caught up")
+
+    # U: the pre-fold-in rows are GONE — a fresh miss, byte-identical
+    # to the cold scatter of the post-fold-in state
+    s, h, u_after = _raw_get(cached.port, pu)
+    assert s == 200 and _verdict(h) == "miss"
+    assert u_after != u_before
+    assert u_after == _raw_get(cold.port, pu)[2]
+    # V: untouched by the fold-in — the entry SURVIVED (precise
+    # invalidation, not an epoch flush) and still serves its bytes
+    s, h, v_after = _raw_get(cached.port, pv)
+    assert s == 200 and _verdict(h) == "hit"
+    assert v_after == v_before
+
+
+# -- 3. admission bypass ------------------------------------------------------
+
+def test_cache_hits_bypass_admission_shedding(cluster, tmp_path):
+    """With the admission gate slammed shut (max-inflight far below
+    the probe's concurrency is the production shape; here: a gate of 1
+    and an occupied slot), cached answers still flow while cold keys
+    shed — overload degrades to 'cached answers + fast 503s'."""
+    cfg_fn = cluster["cfg_fn"]
+    router = RouterLayer(cfg_fn({
+        "oryx.cluster.cache.enabled": True,
+        "oryx.cluster.admission.max-inflight": 1}), port=0)
+    router.start()
+    try:
+        _await(lambda: _raw_get(router.port, "/ready")[0] in (200, 204),
+               "admission router readiness")
+        uid = cluster["users"][0]
+        path = f"/recommend/{uid}?howMany=5"
+        _, h, body = _raw_get(router.port, path)
+        assert _verdict(h) == "miss"
+        # hold the single admission slot hostage
+        assert router.admission.try_acquire()[0]
+        try:
+            s, h, b = _raw_get(router.port, path)
+            assert s == 200 and _verdict(h) == "hit" and b == body
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _raw_get(router.port,
+                         f"/recommend/{cluster['users'][1]}?howMany=5")
+            assert e.value.code == 503  # cold key: shed at the door
+            assert e.value.headers.get("Retry-After")
+        finally:
+            router.admission.release()
+    finally:
+        router.close()
+
+
+# -- 4. chaos -----------------------------------------------------------------
+
+def test_stale_feed_stall_counts_and_generation_flush_rescues(cluster):
+    """``router-cache-stale-feed``: the invalidation tap stalls, the
+    touched user's cached rows keep serving (counted evidence), and
+    the epoch flush on the next generation publish is the safety
+    valve."""
+    from oryx_tpu.kafka.api import KEY_MODEL
+    cached, cold, speed = (cluster["cached"], cluster["cold"],
+                           cluster["speed"])
+    broker = cluster["broker"]
+    w = cluster["users"][0]
+    item = _foldable_item(cluster, w)
+    _flush(cached)
+    pw = f"/recommend/{w}?howMany=8"
+    _, _, w_before = _raw_get(cached.port, pw)
+    faults.inject("router-cache-stale-feed", mode="drop", times=None)
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{cached.port}/pref/{w}/{item}",
+            data=b"4.0", method="POST")
+        with urllib.request.urlopen(req, timeout=15) as r:
+            assert r.status in (200, 204)
+        speed.run_one_micro_batch()
+        _await(lambda: _raw_get(cold.port, pw)[2] != w_before,
+               "replicas absorbed the fold-in")
+        _await(lambda: _cache_stats(cached)["stale_feed_stalls"] > 0,
+               "stall evidence counted")
+        # the stalled tap leaves the PRE-fold-in rows serving: the
+        # documented failure mode, visible and bounded
+        s, h, still = _raw_get(cached.port, pw)
+        assert s == 200 and _verdict(h) == "hit" and still == w_before
+    finally:
+        faults.clear("router-cache-stale-feed")
+    # safety valve: a generation publish flushes the epoch even though
+    # the per-user feed was stalled while armed
+    _, _, doc = _model_doc()
+    flushes_before = _cache_stats(cached)["epoch_flushes"]
+    broker.send(UPDATE_TOPIC, KEY_MODEL, doc)
+    _await(lambda: _cache_stats(cached)["epoch_flushes"] > flushes_before,
+           "generation publish flushed the epoch")
+
+    def fresh():
+        s, h, now = _raw_get(cached.port, pw)
+        return s == 200 and now == _raw_get(cold.port, pw)[2]
+    _await(fresh, "post-flush answers fresh")
+
+
+def test_coalesce_leader_death_followers_reissue(cluster):
+    """``router-coalesce-leader-death``: the latch leader dies — every
+    follower re-issues its own scatter; nobody hangs, nobody serves a
+    broken entry."""
+    cached, cold = cluster["cached"], cluster["cold"]
+    _flush(cached)
+    uid = cluster["users"][2]
+    path = f"/recommend/{uid}?howMany=9"
+    _, _, cold_body = _raw_get(cold.port, path)
+    faults.inject("router-coalesce-leader-death", mode="error", times=1)
+    results = []
+    barrier = threading.Barrier(6)
+
+    def one():
+        barrier.wait()
+        try:
+            s, h, b = _raw_get(cached.port, path, timeout=30)
+            results.append((s, b))
+        except urllib.error.HTTPError as e:
+            e.read()
+            results.append((e.code, None))
+
+    threads = [threading.Thread(target=one) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30.0)
+    assert len(results) == 6  # nobody hung
+    assert faults.fired("router-coalesce-leader-death") == 1
+    oks = [b for s, b in results if s == 200]
+    assert len(oks) >= 5  # only the injected leader may have died
+    assert all(b == cold_body for b in oks)
+
+
+def test_partial_answers_are_never_cached(cluster):
+    """A shard stalled past the deadline degrades to a partial answer
+    — stamped miss, never stored: the next full answer is a miss too,
+    and only IT becomes the cached entry."""
+    cached = cluster["cached"]
+    _flush(cached)
+    uid = cluster["users"][1]
+    path = f"/recommend/{uid}?howMany=6"
+    faults.inject("router-shard-timeout", mode="delay", times=1,
+                  delay_sec=2.0)
+    s, h, _ = _raw_get(cached.port, path,
+                       headers={"X-Deadline-Ms": "800"}, timeout=15)
+    assert s == 200
+    assert h.get("X-Oryx-Partial") == "shards=1/2"
+    assert _verdict(h) == "miss"
+    # the partial was NOT stored: the next request misses again ...
+    s, h, full = _raw_get(cached.port, path)
+    assert s == 200 and h.get("X-Oryx-Partial") is None
+    assert _verdict(h) == "miss"
+    # ... and the full answer is what hits from now on
+    s, h, again = _raw_get(cached.port, path)
+    assert _verdict(h) == "hit" and again == full
+
+
+def test_admin_cache_stats_and_flush_surface(cluster):
+    cached = cluster["cached"]
+    uid = cluster["users"][0]
+    _raw_get(cached.port, f"/recommend/{uid}?howMany=3")
+    st = _cache_stats(cached)
+    assert st["enabled"] and st["coalesce"]
+    assert st["entries"] >= 1 and st["bytes"] > 0
+    assert {"hits", "misses", "evictions", "invalidations",
+            "coalesced_requests", "stale_feed_stalls",
+            "epoch_flushes"} <= set(st)
+    out = _flush(cached)
+    assert out["flushed"] >= 1 and out["stats"]["entries"] == 0
+    # the metrics surface carries the same stats block + counters
+    _, _, m = _raw_get(cached.port, "/metrics")
+    m = json.loads(m)
+    assert "cache" in m["cluster"]
+    assert "cache_hits" in m["counters"]
+
+
+def test_cold_router_404s_admin_cache(cluster):
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _raw_get(cluster["cold"].port, "/admin/cache")
+    assert e.value.code == 404

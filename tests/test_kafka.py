@@ -95,6 +95,24 @@ def test_persistence_round_trip(tmp_path):
     assert b2.get_offset("g", "t") == 1
 
 
+def test_send_after_close_reopens_the_durable_log(tmp_path):
+    """A close()d durable broker handed back by the process-local
+    registry must NOT ack appends into memory only: a record invisible
+    to every other process is acked-but-lost.  The partition re-opens
+    its log on the next append instead (found driving the router's
+    cache-invalidation tap with a publisher that had sanity-read and
+    closed the same file:// broker earlier in the process)."""
+    b1 = InProcBroker("reopen", persist_dir=str(tmp_path))
+    b1.send("t", "k", "v1")
+    b1.close()
+    assert b1.send("t", "k", "v2") == 1  # would previously ack to RAM
+    # a fresh broker over the same dir sees BOTH records
+    b2 = InProcBroker("reopen2", persist_dir=str(tmp_path))
+    msgs = [km.message for km in
+            b2.consume("t", from_beginning=True, max_idle_sec=0.1)]
+    assert msgs == ["v1", "v2"]
+
+
 def test_producer_and_uri_resolution():
     uri = "memory://uri-test"
     p = InProcTopicProducer(uri, "topicA")

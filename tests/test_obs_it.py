@@ -419,7 +419,10 @@ def test_profile_slow_fault_pins_only_the_capture(obs_cluster):
     replica = obs_cluster["replicas"][0]
     router = obs_cluster["router"]
     uid = _user_ids(router.port)[0]
-    faults.inject("obs-profile-slow", mode="delay", delay_sec=0.4,
+    # a wide stall window: the pinned-vs-serving comparison below must
+    # survive multi-hundred-ms scheduler hiccups on a busy 2-core box
+    # (0.4 s flaked under full-suite load)
+    faults.inject("obs-profile-slow", mode="delay", delay_sec=1.5,
                   times=1)
     box = {}
 
@@ -438,9 +441,9 @@ def test_profile_slow_fault_pins_only_the_capture(obs_cluster):
                         f"/shard/recommend/{uid}?howMany=3")
     served_ms = (time.monotonic() - t0) * 1000.0
     assert status == 200
-    th.join(10.0)
+    th.join(20.0)
     assert box["profile"][0] == 200
-    assert box["profile"][2]["captured_ms"] >= 400.0
+    assert box["profile"][2]["captured_ms"] >= 1400.0
     assert served_ms < box["profile"][2]["captured_ms"]
 
 

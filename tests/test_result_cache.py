@@ -1,0 +1,472 @@
+"""Unit tests for the router's exact result cache + single-flight
+coalescer (cluster/result_cache.py, ISSUE 8): key/tag extraction,
+byte-identical render variants, LRU budgets, precise fold-in
+invalidation with store fencing, epoch flushes, coalescing leader/
+follower protocol, and the two chaos points
+(``router-cache-stale-feed``, ``router-coalesce-leader-death``).
+
+Marker: chaos only where a fault is armed; everything is in-process
+and deterministic.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import threading
+
+import pytest
+
+from oryx_tpu.cluster.result_cache import ResultCache, route_tags
+from oryx_tpu.common.config import from_dict
+from oryx_tpu.lambda_rt.http import json_or_csv
+from oryx_tpu.lambda_rt.metrics import MetricsRegistry
+from oryx_tpu.resilience import faults
+from oryx_tpu.serving.als import IDValue
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+class _Reg:
+    """MembershipRegistry stand-in: just the cache's epoch surface."""
+
+    def __init__(self):
+        self.epoch = (2, (5, 5), False)
+
+    def generation_topology(self):
+        return self.epoch
+
+
+def _render(value, kind):
+    return json_or_csv(value,
+                       "text/csv" if kind == "csv"
+                       else "application/json")
+
+
+class _Clock:
+    """Injectable monotonic clock (the invalidation quarantine is
+    time-based; tests advance it explicitly via ``rc._clock.t``)."""
+
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def _build(store=True, coalesce=True, **kv):
+    overlay = {"oryx.cluster.cache.enabled": store,
+               "oryx.cluster.coalesce.enabled": coalesce}
+    overlay.update(kv)
+    reg = _Reg()
+    metrics = MetricsRegistry()
+    rc = ResultCache(from_dict(overlay), metrics, reg, clock=_Clock())
+    return rc, reg, metrics
+
+
+def _probe(rc, uid="u1", how_many="10", pattern="/recommend/{userID}"):
+    return rc.probe(pattern, f"/recommend/{uid}",
+                    {"howMany": [how_many]}, {"userID": uid})
+
+
+def _rows(*pairs):
+    return [IDValue(i, v) for i, v in pairs]
+
+
+# -- key/tag extraction -------------------------------------------------------
+
+def test_route_tags_cover_the_cacheable_surface():
+    assert route_tags("/recommend/{userID}", {"userID": "u"}) \
+        == (("u",), ())
+    assert route_tags("/recommendToMany/{userIDs:+}",
+                      {"userIDs": "a/b"}) == (("a", "b"), ())
+    assert route_tags("/recommendToAnonymous/{itemIDs:+}",
+                      {"itemIDs": "i1=2.5/i2"}) == ((), ("i1", "i2"))
+    assert route_tags("/recommendWithContext/{userID}/{itemIDs:+}",
+                      {"userID": "u", "itemIDs": "i1=1.5"}) \
+        == (("u",), ("i1",))
+    assert route_tags("/similarity/{itemIDs:+}",
+                      {"itemIDs": "i1/i2"}) == ((), ("i1", "i2"))
+    assert route_tags("/similarityToItem/{toItemID}/{itemIDs:+}",
+                      {"toItemID": "t", "itemIDs": "i1/i2"}) \
+        == ((), ("t", "i1", "i2"))
+    assert route_tags("/estimate/{userID}/{itemIDs:+}",
+                      {"userID": "u", "itemIDs": "i1/i2"}) \
+        == (("u",), ("i1", "i2"))
+    assert route_tags("/estimateForAnonymous/{toItemID}/{itemIDs:+}",
+                      {"toItemID": "t", "itemIDs": "i=0.5"}) \
+        == ((), ("t", "i"))
+    assert route_tags("/because/{userID}/{itemID}",
+                      {"userID": "u", "itemID": "i"}) == (("u",), ("i",))
+    assert route_tags("/mostSurprising/{userID}", {"userID": "u"}) \
+        == (("u",), ())
+    assert route_tags("/knownItems/{userID}", {"userID": "u"}) \
+        == (("u",), ())
+    # global aggregates have no precise invalidation key
+    assert route_tags("/mostPopularItems", {}) is None
+    assert route_tags("/allItemIDs", {}) is None
+
+
+def test_probe_rejects_rescorer_params_and_unkeyed_routes():
+    rc, _, _ = _build()
+    assert rc.probe("/recommend/{userID}", "/recommend/u",
+                    {"rescorerParams": ["x"]}, {"userID": "u"}) is None
+    assert rc.probe("/mostPopularItems", "/mostPopularItems",
+                    {}, {}) is None
+    p = _probe(rc)
+    assert p is not None
+    assert ("u", "u1") in p.tags
+
+
+def test_probe_key_distinguishes_args_and_epoch():
+    rc, reg, _ = _build()
+    a = _probe(rc, how_many="10")
+    b = _probe(rc, how_many="20")
+    assert a.key != b.key
+    reg.epoch = (2, (6, 5), False)  # one shard's generation moved
+    c = _probe(rc, how_many="10")
+    assert c.key != a.key
+
+
+def test_mixed_generation_group_is_uncacheable():
+    """While a replica group spans generations mid-rollout, a hedge
+    may fall back to an older-generation sibling and win — a complete
+    answer is not provably of the newest generation, so the cache
+    stands down until the group converges."""
+    rc, reg, _ = _build()
+    reg.epoch = (2, (6, 6), True)
+    assert _probe(rc) is None
+    reg.epoch = (2, (6, 6), False)
+    assert _probe(rc) is not None
+
+
+def test_membership_generation_topology_flags_mixed_groups():
+    from oryx_tpu.cluster.membership import Heartbeat, MembershipRegistry
+    reg = MembershipRegistry(ttl_sec=60.0)
+
+    def beat(rid, shard, gen, of=2):
+        reg.note(Heartbeat(replica=rid, shard=shard, of=of,
+                           url=f"http://h/{rid}", generation=gen,
+                           ready=True))
+
+    beat("a", 0, 3)
+    beat("b", 1, 3)
+    assert reg.generation_topology() == (2, (3, 3), False)
+    beat("a2", 0, 4)  # rollout: shard 0's group now spans 3 and 4
+    of, gens, mixed = reg.generation_topology()
+    assert (of, gens, mixed) == (2, (4, 3), True)
+    beat("a", 0, 4)   # group converges
+    assert reg.generation_topology() == (2, (4, 3), False)
+
+
+# -- store / hit / variants ---------------------------------------------------
+
+def test_store_then_hit_is_byte_identical_to_cold_render():
+    rc, _, metrics = _build()
+    p = _probe(rc)
+    value = _rows(("i1", 2.5), ("i2", 1.0))
+    assert rc.lookup(p) is None
+    entry = rc.store(p, 200, value, {}, _render)
+    assert entry is not None
+    hit = rc.lookup(_probe(rc))
+    assert hit is entry
+    cold_json = json_or_csv(value, "application/json")
+    cold_csv = json_or_csv(value, "text/csv")
+    assert rc.render(entry, False, False, _render)[:2] == \
+        (cold_json[0], cold_json[1])
+    assert rc.render(entry, True, False, _render)[:2] == \
+        (cold_csv[0], cold_csv[1])
+    assert metrics.counters_snapshot()["cache_hits"] == 1
+    assert metrics.counters_snapshot()["cache_misses"] == 1
+
+
+def test_uncacheable_results_are_never_stored():
+    rc, _, _ = _build()
+    # partial answers (extra headers), errors, empty values
+    assert rc.store(_probe(rc), 200, _rows(("i", 1.0)),
+                    {"X-Oryx-Partial": "shards=1/2"}, _render) is None
+    assert rc.store(_probe(rc), 404, _rows(("i", 1.0)), {},
+                    _render) is None
+    assert rc.store(_probe(rc), 200, None, {}, _render) is None
+    assert rc.stats()["entries"] == 0
+
+
+def test_gzip_variant_renders_once_and_is_reused():
+    rc, _, _ = _build()
+    value = _rows(*[(f"item-{j}", float(j)) for j in range(50)])
+    entry = rc.store(_probe(rc), 200, value, {}, _render)
+    payload, ctype, gzipped = rc.render(entry, False, True, _render)
+    assert gzipped and ctype == "application/json"
+    raw = json_or_csv(value, "application/json")[0]
+    assert gzip.decompress(payload) == raw
+    again = rc.render(entry, False, True, _render)[0]
+    assert again is payload  # memoized bytes, no recompression
+    # the variants charge the byte budget
+    assert entry.bytes >= len(raw) + len(payload)
+    assert rc.stats()["bytes"] == entry.bytes
+
+
+def test_value_footprint_charged_then_dropped_after_csv_render():
+    """The retained Python value is charged to the byte budget (a
+    multiple of its JSON bytes) and dropped — charge released — once
+    both plain variant kinds exist; gzip derives from the bytes."""
+    rc, _, _ = _build()
+    value = _rows(*[(f"item-{j}", float(j)) for j in range(30)])
+    entry = rc.store(_probe(rc), 200, value, {}, _render)
+    raw = json_or_csv(value, "application/json")[0]
+    assert entry.value_charge > 0
+    assert entry.bytes == len(raw) + entry.value_charge
+    before = entry.bytes
+    csv_payload = rc.render(entry, True, False, _render)[0]
+    assert entry.value is None and entry.value_charge == 0
+    assert entry.bytes == before - 3 * len(raw) + len(csv_payload)
+    assert rc.stats()["bytes"] == entry.bytes
+    # a later gzip render still works, from the rendered bytes
+    gz = rc.render(entry, False, True, _render)[0]
+    assert gzip.decompress(gz) == raw
+
+
+def test_small_bodies_skip_gzip_like_cold_sends():
+    rc, _, _ = _build()
+    entry = rc.store(_probe(rc), 200, _rows(("i", 1.0)), {}, _render)
+    payload, _, gzipped = rc.render(entry, False, True, _render)
+    assert not gzipped
+    assert payload == json_or_csv(_rows(("i", 1.0)),
+                                  "application/json")[0]
+
+
+def test_lru_evicts_by_entry_and_byte_budget():
+    rc, _, metrics = _build(**{"oryx.cluster.cache.max-entries": 3})
+    for j in range(5):
+        rc.store(_probe(rc, uid=f"u{j}"), 200, _rows((f"i{j}", 1.0)),
+                 {}, _render)
+    st = rc.stats()
+    assert st["entries"] == 3 and st["evictions"] == 2
+    assert metrics.counters_snapshot()["cache_evictions"] == 2
+    # oldest evicted: u0/u1 gone, u4 present
+    assert rc.lookup(_probe(rc, uid="u0")) is None
+    assert rc.lookup(_probe(rc, uid="u4")) is not None
+
+    rc2, _, _ = _build(**{"oryx.cluster.cache.max-bytes": 200})
+    big = _rows(*[(f"item-{j}", float(j)) for j in range(20)])
+    rc2.store(_probe(rc2, uid="a"), 200, big, {}, _render)
+    rc2.store(_probe(rc2, uid="b"), 200, big, {}, _render)
+    assert rc2.stats()["bytes"] <= 200 or rc2.stats()["entries"] <= 1
+
+
+# -- precise invalidation -----------------------------------------------------
+
+def test_x_record_evicts_exactly_the_touched_user():
+    rc, _, metrics = _build()
+    for uid in ("u1", "u2"):
+        rc.store(_probe(rc, uid=uid), 200, _rows((f"i-{uid}", 1.0)),
+                 {}, _render)
+    rc.note_up(json.dumps(["X", "u1", [0.1, 0.2], ["i9"]]))
+    assert rc.lookup(_probe(rc, uid="u1")) is None   # touched: evicted
+    assert rc.lookup(_probe(rc, uid="u2")) is not None  # survives
+    assert rc.stats()["invalidations"] == 1
+    assert metrics.counters_snapshot()["cache_invalidations"] == 1
+
+
+def test_y_record_evicts_item_keys_and_the_named_user():
+    rc, _, _ = _build()
+    sim = rc.probe("/similarity/{itemIDs:+}", "/similarity/i1/i2",
+                   {}, {"itemIDs": "i1/i2"})
+    rc.store(sim, 200, _rows(("i3", 0.9)), {}, _render)
+    rc.store(_probe(rc, uid="u1"), 200, _rows(("i1", 1.0)), {},
+             _render)
+    rc.store(_probe(rc, uid="u2"), 200, _rows(("i9", 1.0)), {},
+             _render)
+    rc.note_up(json.dumps(["Y", "i1", [0.1, 0.2], ["u1"]]))
+    assert rc.lookup(rc.probe("/similarity/{itemIDs:+}",
+                              "/similarity/i1/i2", {},
+                              {"itemIDs": "i1/i2"})) is None
+    assert rc.lookup(_probe(rc, uid="u1")) is None
+    assert rc.lookup(_probe(rc, uid="u2")) is not None
+
+
+def test_malformed_up_records_are_ignored():
+    rc, _, _ = _build()
+    rc.store(_probe(rc), 200, _rows(("i", 1.0)), {}, _render)
+    rc.note_up("not json")
+    rc.note_up(json.dumps({"kind": "X"}))
+    assert rc.lookup(_probe(rc)) is not None
+
+
+def test_generation_publish_flushes_the_epoch():
+    rc, _, _ = _build()
+    rc.store(_probe(rc, uid="u1"), 200, _rows(("i", 1.0)), {}, _render)
+    rc.store(_probe(rc, uid="u2"), 200, _rows(("i", 1.0)), {}, _render)
+    rc.note_generation_publish()
+    st = rc.stats()
+    assert st["entries"] == 0 and st["epoch_flushes"] == 1
+
+
+def test_store_is_fenced_by_invalidation_during_flight():
+    """A scatter that read pre-fold-in replica state must not insert
+    over a newer invalidation: the zero-stale race guard."""
+    rc, _, _ = _build()
+    p = _probe(rc, uid="u1")           # probe minted BEFORE the UP
+    rc.note_up(json.dumps(["X", "u1", [0.1], []]))
+    # fenced: neither retained nor handed to coalesced followers — a
+    # follower may have arrived AFTER the tap applied the eviction,
+    # and sharing these bytes would serve pre-fold-in rows past it
+    assert rc.store(p, 200, _rows(("stale", 1.0)), {}, _render) is None
+    assert rc.lookup(_probe(rc, uid="u1")) is None
+    assert rc.stats()["store_rejects"] == 1
+    # a probe minted AFTER the invalidation but within the quarantine
+    # window is refused too: the router's tap can run a beat ahead of
+    # a replica's replay of the same topic, so a just-evicted tag
+    # stays store-quarantined until the replicas have caught up
+    assert rc.store(_probe(rc, uid="u1"), 200, _rows(("racy", 1.0)),
+                    {}, _render) is None
+    assert rc.lookup(_probe(rc, uid="u1")) is None
+    assert rc.stats()["store_rejects"] == 2
+    # past the quarantine, a fresh probe stores fine
+    rc._clock.t += rc.quarantine_sec + 0.01
+    assert rc.store(_probe(rc, uid="u1"), 200, _rows(("fresh", 1.0)),
+                    {}, _render) is not None
+    assert rc.lookup(_probe(rc, uid="u1")) is not None
+
+
+def test_store_is_fenced_by_epoch_move():
+    rc, reg, _ = _build()
+    p = _probe(rc)
+    reg.epoch = (2, (6, 6), False)  # rollout finished mid-request
+    assert rc.store(p, 200, _rows(("i", 1.0)), {}, _render) is None
+    assert rc.stats()["entries"] == 0
+
+
+def test_flush_is_a_store_fence_too():
+    rc, _, _ = _build()
+    p = _probe(rc)
+    rc.flush("admin")
+    rc.store(p, 200, _rows(("i", 1.0)), {}, _render)
+    assert rc.lookup(_probe(rc)) is None
+
+
+# -- single-flight coalescing -------------------------------------------------
+
+def test_followers_reuse_the_leaders_rendered_result():
+    rc, _, metrics = _build()
+    p = _probe(rc)
+    kind, flight = rc.begin_flight(p, None)
+    assert kind == "lead"
+    results = []
+    ready = []
+
+    def follower():
+        fp = _probe(rc)
+        ready.append(1)
+        results.append(rc.begin_flight(fp, None))
+
+    threads = [threading.Thread(target=follower) for _ in range(3)]
+    for t in threads:
+        t.start()
+    # wait until every follower is at (or inside) its latch before the
+    # leader publishes — a follower arriving after the finish would
+    # correctly lead its own flight, which is not this test
+    while len(ready) < 3:
+        threading.Event().wait(0.01)
+    threading.Event().wait(0.3)
+    entry = rc.store(p, 200, _rows(("i1", 2.0)), {}, _render)
+    rc.finish_flight(flight, entry)
+    for t in threads:
+        t.join(5.0)
+    assert len(results) == 3
+    assert all(k == "coalesced" and e is entry for k, e in results)
+    assert metrics.counters_snapshot()["coalesced_requests"] == 3
+    assert rc.stats()["in_flight"] == 0
+
+
+def test_leader_death_wakes_followers_to_their_own_scatter():
+    rc, _, _ = _build()
+    p = _probe(rc)
+    kind, flight = rc.begin_flight(p, None)
+    assert kind == "lead"
+    out = []
+
+    def follower():
+        out.append(rc.begin_flight(_probe(rc), None))
+
+    t = threading.Thread(target=follower)
+    t.start()
+    rc.finish_flight(flight, None)  # leader died / result uncacheable
+    t.join(5.0)
+    assert out and out[0] == ("solo", None)
+    assert rc.stats()["coalesce_fallthroughs"] == 1
+    # the NEXT request can lead again
+    assert rc.begin_flight(_probe(rc), None)[0] == "lead"
+
+
+def test_finish_flight_is_idempotent():
+    rc, _, _ = _build()
+    _, flight = rc.begin_flight(_probe(rc), None)
+    entry = rc.store(_probe(rc), 200, _rows(("i", 1.0)), {}, _render)
+    rc.finish_flight(flight, entry)
+    rc.finish_flight(flight, None)  # late duplicate must not clobber
+    assert flight.entry is entry
+
+
+def test_coalesce_disabled_means_solo():
+    rc, _, _ = _build(coalesce=False)
+    assert rc.begin_flight(_probe(rc), None) == ("solo", None)
+
+
+@pytest.mark.chaos
+def test_coalesce_leader_death_chaos_point():
+    """``router-coalesce-leader-death``: the would-be leader dies at
+    the latch — followers are woken empty-handed and fall through; the
+    next request leads normally (no permanently poisoned key)."""
+    rc, _, _ = _build()
+    faults.inject("router-coalesce-leader-death", mode="error", times=1)
+    with pytest.raises(faults.InjectedFault):
+        rc.begin_flight(_probe(rc), None)
+    assert faults.fired("router-coalesce-leader-death") == 1
+    kind, _ = rc.begin_flight(_probe(rc), None)
+    assert kind == "lead"  # flight cleaned up, no hang
+
+
+@pytest.mark.chaos
+def test_stale_feed_chaos_counts_and_generation_flush_rescues():
+    """``router-cache-stale-feed``: a stalled invalidation tap leaves
+    the touched user's entry in place (counted), and the epoch flush
+    on the next generation publish is the safety valve."""
+    rc, _, metrics = _build()
+    rc.store(_probe(rc, uid="u1"), 200, _rows(("pre", 1.0)), {},
+             _render)
+    faults.inject("router-cache-stale-feed", mode="drop", times=None)
+    rc.note_up(json.dumps(["X", "u1", [0.1], []]))
+    assert rc.lookup(_probe(rc, uid="u1")) is not None  # stale served
+    assert rc.stats()["stale_feed_stalls"] == 1
+    assert metrics.counters_snapshot()["cache_stale_feed_stalls"] == 1
+    rc.note_generation_publish()  # the safety valve
+    assert rc.lookup(_probe(rc, uid="u1")) is None
+
+
+# -- config gates -------------------------------------------------------------
+
+def test_from_config_is_none_unless_a_gate_is_armed():
+    reg, metrics = _Reg(), MetricsRegistry()
+    assert ResultCache.from_config(from_dict({}), metrics, reg) is None
+    rc = ResultCache.from_config(
+        from_dict({"oryx.cluster.cache.enabled": True}), metrics, reg)
+    assert rc is not None and rc.store_enabled and not rc.coalesce
+    rc = ResultCache.from_config(
+        from_dict({"oryx.cluster.coalesce.enabled": True}), metrics,
+        reg)
+    assert rc is not None and rc.coalesce and not rc.store_enabled
+
+
+def test_coalesce_only_mode_shares_without_retaining():
+    rc, _, _ = _build(store=False, coalesce=True)
+    p = _probe(rc)
+    entry = rc.store(p, 200, _rows(("i", 1.0)), {}, _render)
+    assert entry is not None          # shareable with followers
+    assert rc.lookup(_probe(rc)) is None  # never retained
+    assert rc.stats()["entries"] == 0
