@@ -124,6 +124,26 @@ class FactorModelBase:
         with self._expected_lock:
             self._expected_item_ids.discard(item_id)
 
+    # -- bulk artifact loads (sharded model distribution) -------------------
+
+    def bulk_load_users(self, ids, matrix: np.ndarray) -> None:
+        """set_user_vector for a whole artifact at once: one vectorized
+        store write, one solver invalidation, one expected-ID sweep —
+        the slice-load path (app/als/slices.py) that replaces the
+        per-row UP replay."""
+        self.X.bulk_load(list(ids), matrix)
+        self.cached_xtx_solver.set_dirty()
+        with self._expected_lock:
+            self._expected_user_ids.difference_update(ids)
+
+    def bulk_load_items(self, ids, matrix: np.ndarray) -> None:
+        """set_item_vector for a whole slice at once (see
+        bulk_load_users)."""
+        self.Y.bulk_load(list(ids), matrix)
+        self.cached_yty_solver.set_dirty()
+        with self._expected_lock:
+            self._expected_item_ids.difference_update(ids)
+
     # -- model swap ---------------------------------------------------------
 
     def set_expected_ids(self, user_ids: Sequence[str],

@@ -11,16 +11,21 @@ loadRescorerProviders (:142-160).
 from __future__ import annotations
 
 import logging
+import time
+
+import numpy as np
 
 from ...api.serving import AbstractServingModelManager
 from ...cluster.membership import KEY_HEARTBEAT
 from ...cluster.sharding import is_local_item, parse_shard_spec
 from ...common import pmml as pmml_io
+from ...common import store
 from ...common.config import Config
 from ...common.lang import RateLimitCheck
 from ...kafka.api import KEY_MODEL, KEY_MODEL_REF, KEY_UP
 from ..pmml_utils import read_pmml_from_update_key_message
 from . import common as als_common
+from . import slices
 from .rescorer import load_rescorer_providers
 from .serving_model import ALSServingModel
 
@@ -84,8 +89,31 @@ class ALSServingModelManager(AbstractServingModelManager):
         # identical on every replica for the same topic replay.
         # Counts EVERY Y id seen, including ones this shard skips.
         self.item_ordinals: dict[str, int] = {}
+        # next ordinal to assign.  NOT len(item_ordinals): a
+        # slice-loaded replica holds ordinals for its LOCAL slices only
+        # (slices carry the global index of each row), so the counter
+        # must advance from the manifest's TOTAL item count — every
+        # replica then assigns the same ordinal to the same
+        # post-publish UP id regardless of which slices it loaded.
+        self._ordinal_next = 0
         # Y vectors skipped as non-local (observability)
         self.skipped_remote_items = 0
+        # -- sharded model distribution (slices.py) ----------------------
+        # slices bulk-loaded, artifact bytes read, and fallbacks to the
+        # monolithic artifacts (missing/corrupt slice, incompatible
+        # ring) — surfaced as gauges on /metrics by the serving layer
+        self.slice_loads = 0
+        self.slice_load_fallbacks = 0
+        self.model_slice_bytes = 0
+        # seconds from MODEL(-REF) receipt to a servable model: the
+        # slice path stamps it when the bulk load finishes; the replay
+        # path stamps it when the UP stream crosses the load-fraction
+        # gate.  THE number sharded distribution exists to shrink.
+        self.model_load_s = 0.0
+        self._model_received_at: float | None = None
+        # sum of the owned slices' manifest Gramians: /shard/yty
+        # answers from it without a device scan until a Y write lands
+        self._slice_yty: "object | None" = None
 
     def get_model(self) -> ALSServingModel | None:
         return self.model
@@ -110,11 +138,23 @@ class ALSServingModelManager(AbstractServingModelManager):
             elif kind == "Y":
                 # ordinal BEFORE the shard filter: the canonical
                 # tie-break must agree across replicas that each skip
-                # different ids
-                self.item_ordinals.setdefault(id_,
-                                              len(self.item_ordinals))
+                # different ids.  The counter advances for EVERY Y
+                # record — not every new id — because a slice-loaded
+                # replica holds only its LOCAL slices' ordinals and
+                # cannot tell a remote MANIFEST item from a genuinely
+                # new one: advancing per record keeps the counter (and
+                # therefore every new id's ordinal) identical on every
+                # replica of the totally ordered topic, whatever subset
+                # each loaded.  setdefault keeps an already-known id's
+                # ordinal stable; the skipped slots are harmless gaps
+                # (ordinals only need a shared total order).
+                self.item_ordinals.setdefault(id_, self._ordinal_next)
+                self._ordinal_next += 1
                 if is_local_item(id_, self.shard_index, self.shard_count):
                     model.set_item_vector(id_, vector)
+                    # a live Y write outdates the manifest's partial
+                    # Gramian: /shard/yty scans again until next load
+                    self._slice_yty = None
                 else:
                     self.skipped_remote_items += 1
             else:
@@ -128,6 +168,13 @@ class ALSServingModelManager(AbstractServingModelManager):
                     and model.get_fraction_loaded()
                     >= self.min_model_load_fraction):
                 self._triggered_solver = True
+                # the replay path's load clock: MODEL receipt -> the UP
+                # stream crossing the serving gate (the slice path
+                # stamps its own, much earlier, moment)
+                if self._model_received_at is not None:
+                    self.model_load_s = round(
+                        time.monotonic() - self._model_received_at, 6)
+                    self._model_received_at = None
                 model.precompute_solvers()
                 # with the factors loaded, time each eligible kernel
                 # path for the live shape so serving routes by
@@ -138,6 +185,15 @@ class ALSServingModelManager(AbstractServingModelManager):
                 _log.info("%s", model)
         elif key in (KEY_MODEL, KEY_MODEL_REF):
             _log.info("Loading new model")
+            t_model = time.monotonic()
+            model_dir = manifest = None
+            if key == KEY_MODEL_REF:
+                # manifest-carrying envelope (slices.py): the record
+                # names the per-slice artifacts this replica may
+                # bulk-load instead of replaying a full UP stream
+                path, model_dir, manifest = slices.parse_model_ref(message)
+                if model_dir is None:
+                    model_dir = path.rsplit("/", 1)[0]
             pmml = read_pmml_from_update_key_message(key, message)
             if pmml is None:
                 self.rejected_models += 1
@@ -185,10 +241,37 @@ class ALSServingModelManager(AbstractServingModelManager):
             self.model.retain_recent_and_user_ids(list(x_ids))
             self.model.retain_recent_and_item_ids(local_y)
             self.generation += 1
+            self._model_received_at = t_model
+            # a NEW generation outdates any held manifest Gramian
+            # immediately (the retains above already pruned rows); a
+            # successful slice load below sets the fresh one
+            self._slice_yty = None
+            if manifest is not None:
+                # sharded distribution: bulk-load exactly this shard's
+                # slices (O(catalog/N)); a bad slice fails closed to
+                # the monolithic artifacts — ready either way
+                self._load_from_manifest(model_dir, manifest)
+            if (self._model_received_at is not None
+                    and self.model.get_fraction_loaded()
+                    >= self.min_model_load_fraction):
+                # the artifacts alone crossed the serving gate (slice
+                # or fallback load): the replica is SERVABLE now —
+                # stamp the load clock before the route measurement
+                # and solver precompute below, which are warmup the
+                # replay path also runs outside its clock
+                self.model_load_s = round(time.monotonic() - t_model, 6)
+                self._model_received_at = None
             # hot-swap: the new generation may have regrown the padded
             # store — refresh the measured-cost kernel route for the
             # new shape (no-op while capacity and LSH config match)
             self.model.refresh_route()
+            if (not self._triggered_solver
+                    and self.model.get_fraction_loaded()
+                    >= self.min_model_load_fraction):
+                # no UP flood follows to fire the load-fraction
+                # trigger, so the solvers precompute here
+                self._triggered_solver = True
+                self.model.precompute_solvers()
             _log.info("Model updated: %s", self.model)
         elif key == KEY_HEARTBEAT:
             # cluster control-plane traffic on the shared update topic;
@@ -197,3 +280,103 @@ class ALSServingModelManager(AbstractServingModelManager):
             return
         else:
             raise ValueError(f"Bad key: {key}")
+
+    # -- sharded model distribution (slices.py) ------------------------------
+
+    def _load_from_manifest(self, model_dir: str, manifest: dict) -> None:
+        """Bulk-load this shard's slices + the user artifact; any
+        integrity failure fails closed to :meth:`_load_full_artifacts`
+        with the ``slice_load_fallbacks`` counter — a corrupt slice
+        costs the O(catalog) load, never readiness."""
+        try:
+            ring = int(manifest["ring"])
+            owned = slices.owned_slices(ring, self.shard_index,
+                                        self.shard_count)
+            if owned is None:
+                raise slices.SliceIntegrityError(
+                    f"slice ring {ring} incompatible with shard count "
+                    f"{self.shard_count} (pick a ring the shard count "
+                    f"divides)")
+            features = self.model.features
+            total_bytes = 0
+            gramian = np.zeros((features, features), dtype=np.float64)
+            # gramians live only in the STORE manifest (k*k floats per
+            # slice would blow the topic's max message size); absence
+            # just means /shard/yty scans instead
+            full = slices.read_manifest(model_dir)
+            grams = (full or {}).get("gramians")
+            entries = {int(e["slice"]): e for e in manifest["slices"]}
+            for s in owned:
+                entry = entries[s]
+                ids, matrix, ordinals = slices.read_slice(
+                    model_dir, entry, features)
+                if ids:
+                    self.model.bulk_load_items(ids, matrix)
+                    self.item_ordinals.update(zip(ids, ordinals))
+                total_bytes += int(entry.get("bytes", 0))
+                if grams is not None:
+                    gramian += np.asarray(grams[s], dtype=np.float64)
+            x_ids, X, known = slices.read_x_known(
+                model_dir, manifest["x"], features)
+            if x_ids:
+                self.model.bulk_load_users(x_ids, X)
+                for uid, items in zip(x_ids, known):
+                    if items:
+                        self.model.add_known_items(uid, items)
+            total_bytes += int(manifest["x"].get("bytes", 0))
+            self._ordinal_next = max(self._ordinal_next,
+                                     int(manifest["items"]))
+            self.slice_loads += len(owned)
+            self.model_slice_bytes = total_bytes
+            self._slice_yty = gramian if grams is not None else None
+            _log.info(
+                "Slice-loaded %d/%d slices (%d items, %d users, %d "
+                "bytes) for shard %d/%d", len(owned), ring,
+                len(self.model.Y), len(self.model.X), total_bytes,
+                self.shard_index, self.shard_count)
+        except (slices.SliceIntegrityError, OSError, KeyError, IndexError,
+                TypeError, ValueError) as e:
+            self.slice_load_fallbacks += 1
+            self._slice_yty = None
+            _log.warning("Slice load failed (%s); falling back to the "
+                         "monolithic artifacts", e)
+            self._load_full_artifacts(model_dir)
+
+    def _load_full_artifacts(self, model_dir: str) -> None:
+        """The fail-closed path: read the monolithic ``Y``/``X``
+        artifacts the publisher still writes, filter to this shard,
+        and assign ordinals by artifact position — exactly the state a
+        full-stream replay would have built (the artifact order IS the
+        stream order)."""
+        from .update import load_features
+        try:
+            y_ids, Y = load_features(store.join(model_dir, "Y"))
+            local = [j for j, iid in enumerate(y_ids)
+                     if is_local_item(iid, self.shard_index,
+                                      self.shard_count)]
+            if local:
+                self.model.bulk_load_items(
+                    [y_ids[j] for j in local], Y[local])
+            self.skipped_remote_items += len(y_ids) - len(local)
+            for j, iid in enumerate(y_ids):
+                self.item_ordinals.setdefault(iid, j)
+            self._ordinal_next = max(self._ordinal_next, len(y_ids))
+            x_ids, X = load_features(store.join(model_dir, "X"))
+            if x_ids:
+                self.model.bulk_load_users(x_ids, X)
+            _log.info("Fallback-loaded monolithic artifacts: %d local "
+                      "items, %d users", len(local), len(x_ids))
+        except (OSError, ValueError) as e:
+            # store unreachable: the replica stays below the serving
+            # gate and the router routes around it — log, don't die
+            _log.error("Monolithic artifact fallback also failed (%s); "
+                       "replica will not reach ready until the store "
+                       "returns", e)
+
+    def partial_yty(self) -> "np.ndarray | None":
+        """This shard's Gramian from the manifest's per-slice partials
+        — lets ``/shard/yty`` answer without a device scan — or None
+        when no fresh manifest Gramian is held (replay-loaded model, a
+        Y write since load, or a manifest without Gramians)."""
+        g = self._slice_yty
+        return None if g is None else np.asarray(g, dtype=np.float64)

@@ -15,6 +15,7 @@ per micro-batch instead of two host solves per event.
 from __future__ import annotations
 
 import logging
+import time
 from typing import Iterable, Sequence
 
 import numpy as np
@@ -28,6 +29,7 @@ from ...kafka.api import KEY_MODEL, KEY_MODEL_REF, KEY_UP, KeyMessage
 from ...ops import als_fold_in
 from ..pmml_utils import read_pmml_from_update_key_message
 from . import common as als_common
+from . import slices
 from .factor_model import FactorModelBase
 
 _log = logging.getLogger(__name__)
@@ -63,6 +65,13 @@ class ALSSpeedModelManager(AbstractSpeedModelManager):
         # integrity counters (mirrors the serving manager)
         self.rejected_updates = 0
         self.rejected_models = 0
+        # sharded model distribution (slices.py): the speed layer folds
+        # against the FULL catalog, so it bulk-loads every slice — far
+        # cheaper than parsing the per-row UP stream the sharded
+        # publisher no longer sends
+        self.slice_loads = 0
+        self.slice_load_fallbacks = 0
+        self.model_load_s = 0.0
 
     # -- consume -------------------------------------------------------------
 
@@ -89,6 +98,12 @@ class ALSSpeedModelManager(AbstractSpeedModelManager):
                 _log.info("%s", self.model)
         elif key in (KEY_MODEL, KEY_MODEL_REF):
             _log.info("Loading new model")
+            t_model = time.monotonic()
+            model_dir = manifest = None
+            if key == KEY_MODEL_REF:
+                path, model_dir, manifest = slices.parse_model_ref(message)
+                if model_dir is None:
+                    model_dir = path.rsplit("/", 1)[0]
             pmml = read_pmml_from_update_key_message(key, message)
             if pmml is None:
                 self.rejected_models += 1
@@ -116,9 +131,47 @@ class ALSSpeedModelManager(AbstractSpeedModelManager):
             self.model.set_expected_ids(x_ids, y_ids)
             self.model.retain_recent_and_user_ids(x_ids)
             self.model.retain_recent_and_item_ids(y_ids)
+            if manifest is not None:
+                self._load_from_manifest(model_dir, manifest)
+                self.model_load_s = round(time.monotonic() - t_model, 6)
             _log.info("Model updated: %s", self.model)
         else:
             raise ValueError(f"Bad key: {key}")
+
+    def _load_from_manifest(self, model_dir: str, manifest: dict) -> None:
+        """Bulk-load EVERY slice plus the user artifact (the speed
+        model is never sharded); a bad slice fails closed to the
+        monolithic artifacts — same contract as the serving manager."""
+        try:
+            features = self.model.features
+            for entry in manifest["slices"]:
+                ids, matrix, _ordinals = slices.read_slice(
+                    model_dir, entry, features)
+                if ids:
+                    self.model.bulk_load_items(ids, matrix)
+            x_ids, X, _known = slices.read_x_known(
+                model_dir, manifest["x"], features)
+            if x_ids:
+                self.model.bulk_load_users(x_ids, X)
+            self.slice_loads += len(manifest["slices"])
+        except (slices.SliceIntegrityError, OSError, KeyError, IndexError,
+                TypeError, ValueError) as e:
+            self.slice_load_fallbacks += 1
+            _log.warning("Speed slice load failed (%s); falling back to "
+                         "the monolithic artifacts", e)
+            from .update import load_features
+            from ...common import store
+            try:
+                y_ids2, Y = load_features(store.join(model_dir, "Y"))
+                if y_ids2:
+                    self.model.bulk_load_items(y_ids2, Y)
+                x_ids2, X2 = load_features(store.join(model_dir, "X"))
+                if x_ids2:
+                    self.model.bulk_load_users(x_ids2, X2)
+            except (OSError, ValueError) as e2:
+                _log.error("Monolithic artifact fallback also failed "
+                           "(%s); speed model stays below the fold-in "
+                           "gate until the store returns", e2)
 
     # -- produce -------------------------------------------------------------
 

@@ -31,6 +31,7 @@ from ...ml.integrity import NumericalDivergenceError, is_finite_array
 from ...ml.mlupdate import MLUpdate
 from . import common as als_common
 from . import evaluation
+from . import slices
 from .trainer import ALSModel, train_als
 
 _log = logging.getLogger(__name__)
@@ -83,6 +84,10 @@ class ALSUpdate(MLUpdate):
         self.no_known_items = config.get_bool("oryx.als.no-known-items")
         self.decay_factor = config.get_double("oryx.als.decay.factor")
         self.decay_zero_threshold = config.get_double("oryx.als.decay.zero-threshold")
+        # sharded model distribution (slices.py): murmur2 ring size for
+        # the per-slice artifacts a too-large-to-inline model publishes
+        # alongside its MODEL-REF; 0 disables (pure reference behavior)
+        self.publish_slices = config.get_int("oryx.als.publish.slices")
         if self.iterations <= 0:
             raise ValueError("iterations must be positive")
         if not 0.0 < self.decay_factor <= 1.0:
@@ -241,12 +246,56 @@ class ALSUpdate(MLUpdate):
     def can_publish_additional_model_data(self) -> bool:
         return True
 
+    def prepare_model_ref_payload(self, model, model_path: str,
+                                  new_data, past_data) -> str:
+        """Sharded distribution (ISSUE 10 tentpole): a too-large model
+        publishes per-slice item-factor artifacts + a manifest next to
+        the PMML, and the MODEL-REF record carries the (slim) manifest
+        so every consumer bulk-loads its murmur2 slices instead of
+        replaying the full UP stream.  Known-items ride with the
+        user-side artifact, so the whole per-row stream is replaced.
+        Any write failure falls back to the bare-path payload — the
+        UP stream then publishes as before (publish_additional checks
+        for the manifest's presence, so the two stay consistent)."""
+        if self.publish_slices < 1 or model is None:
+            return model_path
+        model_dir = model_path.rsplit("/", 1)[0]
+        try:
+            y_ids, Y = load_features(
+                store.join(model_dir, pmml_io.get_extension_value(model, "Y")))
+            x_ids, X = load_features(
+                store.join(model_dir, pmml_io.get_extension_value(model, "X")))
+            known = None
+            if not self.no_known_items:
+                all_events = als_common.parse_events(
+                    list(new_data) + list(past_data), 1.0, 0.0)
+                known = als_common.build_known_items(all_events)
+            slim = slices.publish_sliced(model_dir, y_ids, Y, x_ids, X,
+                                         known, self.publish_slices)
+            _log.info("Published sharded manifest: %d slices, %d items, "
+                      "%d users at %s", self.publish_slices, len(y_ids),
+                      len(x_ids), model_dir)
+            return slices.model_ref_message(model_path, model_dir, slim)
+        except OSError:
+            _log.warning("Sharded slice publish failed; falling back to "
+                         "the bare MODEL-REF + UP stream", exc_info=True)
+            return model_path
+
     def publish_additional_model_data(self, model: Element, new_data, past_data,
                                       model_path: str,
                                       model_update_topic: TopicProducer) -> None:
         """Stream every factor row as an "UP" message — items first so
         user endpoints return complete results once they stop 404ing
-        (reference: publishAdditionalModelData :287-319)."""
+        (reference: publishAdditionalModelData :287-319).  When the
+        generation published a sharded manifest (prepare_model_ref
+        wrote slices + X-with-known-items next to the model), the
+        stream is fully replaced by bulk slice loads at the consumers
+        and is skipped here — O(catalog) publish AND load both go."""
+        if self.publish_slices >= 1 and store.exists(
+                store.join(model_path, slices.MANIFEST_FILE)):
+            _log.info("Sharded manifest present at %s; skipping the "
+                      "Y/X UP stream", model_path)
+            return
         y_rel = pmml_io.get_extension_value(model, "Y")
         y_ids, Y = load_features(store.join(model_path, y_rel))
         for id_, row in zip(y_ids, Y):

@@ -140,18 +140,22 @@ def read_pmml_from_update_key_message(key: str, message: str) -> Element | None:
                          len(message))
             return None
     if key == KEY_MODEL_REF:
+        # a manifest-carrying envelope (app/als/slices.py) wraps the
+        # path in JSON; bare-path payloads pass through unchanged
+        from .als.slices import parse_model_ref
+        path, _, _ = parse_model_ref(message)
         # open-and-catch, not exists-then-read: TTL cleanup may race
         # the resolve, and one round trip beats two on a remote store
         try:
             # chaos seam: a corrupt/truncated artifact at the ref path
             _fault("store-corrupt-model", error=lambda: ModelIntegrityError(
-                f"injected corrupt model artifact at {message}"))
-            return pmml_io.read(message)
+                f"injected corrupt model artifact at {path}"))
+            return pmml_io.read(path)
         except (FileNotFoundError, OSError):
-            _log.warning("Unable to load model file at %s; ignoring", message)
+            _log.warning("Unable to load model file at %s; ignoring", path)
             return None
         except (ET.ParseError, ModelIntegrityError):
             _log.warning("Corrupt or truncated model artifact at %s; "
-                         "ignoring", message)
+                         "ignoring", path)
             return None
     raise ValueError(f"Bad key: {key}")

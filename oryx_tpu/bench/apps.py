@@ -73,7 +73,7 @@ def bench_kmeans(n_points: int = 5_000_000, dims: int = 20, k: int = 100,
         "points": n_points, "dims": dims, "k": k,
         "iterations": iterations,
         "upload_s": round(upload, 2),
-        "total_s": round(total, 2),
+        "total_s": round(total, 4),
         "init_s": round(timings["init_s"], 2),
         "lloyd_s": round(timings["lloyd_s"], 2),
         # per-Lloyd-iteration metrics divide by Lloyd time only, so
@@ -156,8 +156,8 @@ def bench_rdf(n_examples: int = 1_000_000, n_predictors: int = 20,
         "metric": "rdf_train",
         "examples": n_train, "predictors": n_predictors,
         "trees": num_trees, "max_depth": max_depth, "bins": bins,
-        "total_s": round(total, 2),
-        "warm_total_s": round(warm_total, 2),
+        "total_s": round(total, 4),
+        "warm_total_s": round(warm_total, 4),
         "examples_x_trees_per_s": round(n_train * num_trees / total, 0),
         "warm_examples_x_trees_per_s": round(
             n_train * num_trees / warm_total, 0),

@@ -13,7 +13,11 @@ Three artifact families share the machinery, selected by ``--kind``:
   pre-r09 artifacts are all R=1).  Since r11 a row's hot-user Zipf
   rung gates as its own ``(..., "zipf")`` pseudo-cell — a
   result-cache regression cannot hide behind a healthy cold cell,
-  and pre-cache artifacts simply lack the cell.
+  and pre-cache artifacts simply lack the cell.  Since r12 a row's
+  per-replica model-load telemetry (sharded model distribution,
+  ISSUE 10) gates as the ``(..., "load")`` pseudo-cell on LOAD SPEED
+  (1 / max replica ``model_load_s``), with the same
+  lacking-cell-is-new back-compat.
 - ``obs``: ``BENCH_OBS_OVERHEAD_*.json`` — the observability
   hot-path microbench (bench/obs_overhead.py).  Gates on two rules:
   a HARD absolute budget (the unsampled per-request pipeline must
@@ -149,6 +153,22 @@ def _cells(doc: dict) -> dict:
             if isinstance(z, dict) \
                     and z.get("open_loop_sustained_qps") is not None:
                 out[key + ("zipf",)] = z
+            # r12 added per-replica model-load telemetry (sharded model
+            # distribution): it gates as its own (..., "load")
+            # pseudo-cell whose headline is LOAD SPEED — 1 /
+            # max-replica model_load_s, so a >10% drop in the gated
+            # number means load time rose >11% (a slice-load
+            # regression cannot hide behind a healthy qps cell).
+            # Pre-r12 artifacts simply lack the cell.
+            load = r.get("model_load")
+            if isinstance(load, dict) \
+                    and load.get("max_replica_load_s"):
+                out[key + ("load",)] = {
+                    "open_loop_sustained_qps": round(
+                        1.0 / load["max_replica_load_s"], 4),
+                    "model_load_s": load["max_replica_load_s"],
+                    "mode": load.get("mode"),
+                }
         return out
     return {(r["features"], r["items"], r["lsh"]): r
             for r in doc.get("rows", [])}

@@ -53,10 +53,17 @@ adds a hot-user rung whose hit rate builds across the ladder
 p50), and ``--coalesce-burst B`` fires waves of identical concurrent
 requests that must collapse onto one scatter.
 
-Writes ``BENCH_GATEWAY_r11.json``; ``bench/check_regression.py
+Since r12 the model publishes SHARDED by default (``--sharded-publish``:
+manifest-carrying MODEL-REF + murmur2 slices, no per-row UP flood —
+``--sharded-publish 0`` reproduces the replay publish), each cell
+records per-replica ``model_load_s``/slice bytes/fallbacks, and
+``--load-compare N`` publishes the same catalog both ways and boots
+the same fleet against each (the O(catalog/N) load evidence).
+
+Writes ``BENCH_GATEWAY_r12.json``; ``bench/check_regression.py
 --kind gateway`` gates successive rounds per (features, items,
-replicas, replicas-per-shard) cell, plus a ``zipf`` pseudo-cell per
-row when the hot-user rung ran.
+replicas, replicas-per-shard) cell, plus ``zipf`` and ``load``
+pseudo-cells per row when those rungs ran.
 """
 
 from __future__ import annotations
@@ -76,7 +83,7 @@ import numpy as np
 
 from ..common import pmml as pmml_io
 from ..common.config import keys_to_hocon
-from ..kafka.api import KEY_MODEL, KEY_UP
+from ..kafka.api import KEY_MODEL, KEY_MODEL_REF, KEY_UP
 from ..kafka.inproc import resolve_broker
 from .load import run_recommend_open_loop
 
@@ -92,7 +99,8 @@ def _free_port() -> int:
 
 
 def _publish_model(broker_dir: str, users: int, items: int,
-                   features: int, seed: int = 5) -> list[str]:
+                   features: int, seed: int = 5,
+                   sharded: int = 0) -> list[str]:
     """MODEL + UP replay onto the file broker — the same stream a
     batch generation publishes, so replicas load through the real
     consume path.  Writes the single-partition topic log directly in
@@ -100,7 +108,12 @@ def _publish_model(broker_dir: str, users: int, items: int,
     broker's per-record append re-reads its own write for multi-writer
     offset agreement, a tax a one-shot half-gigabyte publish need not
     pay.  A post-write ``resolve_broker`` sanity read keeps the layout
-    honest."""
+    honest.
+
+    ``sharded`` > 0 publishes the SHARDED form instead (ISSUE 10): a
+    manifest-carrying MODEL-REF whose per-murmur2-slice artifacts live
+    next to the PMML, and NO per-row UP flood — each replica
+    bulk-loads only its slices (O(catalog/N) load)."""
     rng = np.random.default_rng(seed)
     os.makedirs(broker_dir, exist_ok=True)
     user_ids = [f"u{j}" for j in range(users)]
@@ -110,15 +123,41 @@ def _publish_model(broker_dir: str, users: int, items: int,
     pmml_io.add_extension(doc, "implicit", True)
     pmml_io.add_extension_content(doc, "XIDs", user_ids)
     pmml_io.add_extension_content(doc, "YIDs", item_ids)
+    y = np.round(rng.standard_normal((items, features)), 4
+                 ).astype(np.float32)
+    x = np.round(rng.standard_normal((users, features)), 4
+                 ).astype(np.float32)
+    if sharded > 0:
+        from ..app.als import slices as model_slices
+        from ..app.als.update import save_features
+        model_dir = os.path.join(broker_dir, "model-gen1")
+        os.makedirs(model_dir, exist_ok=True)
+        pmml_path = os.path.join(model_dir, "model.pmml.xml")
+        pmml_io.write(doc, pmml_path)
+        # the monolithic artifacts ride ALONGSIDE the slices, exactly
+        # like the real publisher's layout — the fail-closed fallback
+        # (corrupt slice, a shard count that does not divide the ring)
+        # reads them, and a bench of that path must not dead-end
+        save_features(os.path.join(model_dir, "Y"), item_ids, y)
+        save_features(os.path.join(model_dir, "X"), user_ids, x)
+        slim = model_slices.publish_sliced(
+            model_dir, item_ids, y, user_ids, x, None, sharded)
+        envelope = model_slices.model_ref_message(pmml_path, model_dir,
+                                                  slim)
+        with open(os.path.join(broker_dir, "GwUp.topic.jsonl"), "a",
+                  encoding="utf-8") as f:
+            f.write(json.dumps([KEY_MODEL_REF, envelope]) + "\n")
+        broker = resolve_broker(f"file://{broker_dir}")
+        assert broker.latest_offset("GwUp") == 1
+        broker.close()
+        return user_ids
     with open(os.path.join(broker_dir, "GwUp.topic.jsonl"), "a",
               encoding="utf-8", buffering=1 << 20) as f:
         f.write(json.dumps([KEY_MODEL, pmml_io.to_string(doc)]) + "\n")
-        y = rng.standard_normal((items, features)).astype(np.float32)
-        for iid, row in zip(item_ids, np.round(y, 4).tolist()):
+        for iid, row in zip(item_ids, y.tolist()):
             f.write(json.dumps(
                 [KEY_UP, json.dumps(["Y", iid, row])]) + "\n")
-        x = rng.standard_normal((users, features)).astype(np.float32)
-        for uid, row in zip(user_ids, np.round(x, 4).tolist()):
+        for uid, row in zip(user_ids, x.tolist()):
             f.write(json.dumps(
                 [KEY_UP, json.dumps(["X", uid, row, []])]) + "\n")
     broker = resolve_broker(f"file://{broker_dir}")
@@ -424,13 +463,15 @@ def run_cell(replicas: int, items: int, features: int, users: int,
              overload_factor: float = 3.0,
              cache: bool = True,
              zipf: float = 0.0,
-             coalesce_burst: int = 0) -> dict:
+             coalesce_burst: int = 0,
+             sharded_publish: int = 0) -> dict:
     publish_s = 0.0
     if broker_dir is None:
         broker_dir = os.path.join(work_dir, f"broker-{replicas}")
         os.makedirs(broker_dir, exist_ok=True)
         t0 = time.time()
-        user_ids = _publish_model(broker_dir, users, items, features)
+        user_ids = _publish_model(broker_dir, users, items, features,
+                                  sharded=sharded_publish)
         publish_s = time.time() - t0
 
     procs: list[subprocess.Popen] = []
@@ -546,6 +587,31 @@ def run_cell(replicas: int, items: int, features: int, users: int,
         _await(lambda: all(_loaded(p) for p in replica_ports),
                "replica model load", timeout=900.0)
         load_s = time.time() - t0
+        # per-replica load telemetry (sharded model distribution,
+        # ISSUE 10): each replica's own receipt-to-servable clock,
+        # slice bytes read, and fallbacks — the evidence that a
+        # slice-loaded fleet loads O(catalog/N) instead of replaying
+        # the whole stream
+        per_replica_load = []
+        for p in replica_ports:
+            g = _get_json(p, "/metrics").get("freshness", {})
+            per_replica_load.append({
+                "port": p,
+                "model_load_s": g.get("model_load_s"),
+                "model_slice_bytes": g.get("model_slice_bytes"),
+                "slice_load_fallbacks": g.get("slice_load_fallbacks"),
+            })
+        loads = [r["model_load_s"] for r in per_replica_load
+                 if r["model_load_s"]]
+        model_load = {
+            "mode": "slices" if sharded_publish > 0 else "replay",
+            "slices": sharded_publish or None,
+            "bench_wall_s": round(load_s, 1),
+            "per_replica": per_replica_load,
+            "max_replica_load_s": round(max(loads), 3) if loads else None,
+            "fallbacks": sum(r["slice_load_fallbacks"] or 0
+                             for r in per_replica_load),
+        }
         _await(lambda: _get_json(router_port, "/metrics")
                ["cluster"]["covered_shards"] == list(range(replicas)),
                "router coverage")
@@ -701,6 +767,7 @@ def run_cell(replicas: int, items: int, features: int, users: int,
                                         else None),
             "publish_s": round(publish_s, 1),
             "model_load_s": round(load_s, 1),
+            "model_load": model_load,
             "merge_spotcheck_ok": spot_ok,
             "partial_answers_during_run": partials,
             "open_loop_sustained_qps":
@@ -726,6 +793,85 @@ def run_cell(replicas: int, items: int, features: int, users: int,
                 p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 p.kill()
+
+
+def _measure_fleet_load(work_dir: str, broker_dir: str, shards: int,
+                        replica_threads: int, tag: str) -> dict:
+    """Boot a ``shards``-way fleet against an already-published broker
+    and measure spawn-to-all-ready wall clock plus each replica's own
+    receipt-to-servable ``model_load_s`` gauge — the load-compare
+    probe's one measurement."""
+    procs, ports = [], []
+    log_path = os.path.join(work_dir, f"load-{tag}.log")
+    try:
+        for s in range(shards):
+            port = _free_port()
+            conf = os.path.join(work_dir, f"load-{tag}-{s}.conf")
+            _write_conf(conf, broker_dir, port, {
+                "oryx.cluster.enabled": True,
+                "oryx.cluster.shard": f"{s}/{shards}",
+                "oryx.cluster.replica-id": f"load{tag}{s}",
+            })
+            procs.append(_spawn(["serving", "--shard", f"{s}/{shards}"],
+                                conf, replica_threads, log_path))
+            ports.append(port)
+        t0 = time.time()
+        _await(lambda: all(
+            _get_json(p, "/shard/meta").get("ready")
+            and _get_json(p, "/metrics").get(
+                "model_fraction_loaded", 0) >= 1.0
+            and _get_json(p, "/metrics").get(
+                "freshness", {}).get("model_load_s", 0) > 0
+            for p in ports), f"load probe {tag}", timeout=900.0)
+        wall = time.time() - t0
+        out = {"wall_s": round(wall, 1), "per_replica": []}
+        for p in ports:
+            g = _get_json(p, "/metrics").get("freshness", {})
+            out["per_replica"].append({
+                "model_load_s": g.get("model_load_s"),
+                "model_slice_bytes": g.get("model_slice_bytes"),
+                "slice_load_fallbacks": g.get("slice_load_fallbacks"),
+            })
+        loads = [r["model_load_s"] for r in out["per_replica"]
+                 if r["model_load_s"]]
+        out["max_replica_load_s"] = round(max(loads), 3) if loads else None
+        return out
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def run_load_compare(work_dir: str, items: int, features: int,
+                     users: int, shards: int, replica_threads: int,
+                     sharded: int) -> dict:
+    """The O(catalog/N) load measurement (ISSUE 10 acceptance): the
+    SAME catalog published both ways — full-stream replay vs sharded
+    manifest — loaded by the same ``shards``-way fleet.  Reports both
+    spawn-to-ready wall clocks and the replicas' own
+    receipt-to-servable clocks, plus their ratio (target: sliced ≤ 60%
+    of replay at 2 shards)."""
+    replay_dir = os.path.join(work_dir, "load-replay-broker")
+    sliced_dir = os.path.join(work_dir, "load-sliced-broker")
+    _publish_model(replay_dir, users, items, features)
+    _publish_model(sliced_dir, users, items, features, sharded=sharded)
+    replay = _measure_fleet_load(work_dir, replay_dir, shards,
+                                 replica_threads, "replay")
+    sliced = _measure_fleet_load(work_dir, sliced_dir, shards,
+                                 replica_threads, "sliced")
+    out = {"items": items, "features": features, "shards": shards,
+           "slices": sharded, "replay": replay, "sliced": sliced}
+    if replay["max_replica_load_s"] and sliced["max_replica_load_s"]:
+        out["replica_load_ratio"] = round(
+            sliced["max_replica_load_s"] / replay["max_replica_load_s"],
+            3)
+    if replay["wall_s"]:
+        out["wall_ratio"] = round(sliced["wall_s"] / replay["wall_s"], 3)
+    return out
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -819,7 +965,22 @@ def main(argv: list[str] | None = None) -> int:
                          "IDENTICAL concurrent requests against a "
                          "cold key — the herd must collapse to one "
                          "scatter (verdicts tallied).  0 = off")
-    ap.add_argument("--out", default="BENCH_GATEWAY_r11.json")
+    ap.add_argument("--sharded-publish", type=int, default=24,
+                    help="publish the model as this many murmur2 "
+                         "slices + a manifest-carrying MODEL-REF (no "
+                         "per-row UP flood) so replicas bulk-load "
+                         "O(catalog/N); each cell records per-replica "
+                         "model_load_s/slice bytes, gated by "
+                         "check_regression as the (..., 'load') "
+                         "pseudo-cell.  0 = the pre-r12 full-stream "
+                         "replay publish")
+    ap.add_argument("--load-compare", type=int, default=0,
+                    help="before the qps cells, publish the catalog "
+                         "BOTH ways and boot this many shards against "
+                         "each, recording replay vs sliced load times "
+                         "and their ratio (the O(catalog/N) "
+                         "acceptance evidence).  0 = off")
+    ap.add_argument("--out", default="BENCH_GATEWAY_r12.json")
     ap.add_argument("--keep-work", action="store_true")
     args = ap.parse_args(argv)
 
@@ -837,11 +998,21 @@ def main(argv: list[str] | None = None) -> int:
         # one shared broker/model stream: every cell's replicas replay
         # the identical totally-ordered topic (cells run sequentially;
         # dead cells' heartbeats age out past the TTL)
+        load_compare = None
+        if args.load_compare > 0:
+            print("== load-compare probe (replay vs sliced) ==",
+                  file=sys.stderr)
+            load_compare = run_load_compare(
+                work_dir, args.items, args.features, args.users,
+                args.load_compare, args.replica_threads,
+                args.sharded_publish or 24)
+            print(json.dumps(load_compare), file=sys.stderr)
         broker_dir = os.path.join(work_dir, "broker")
         os.makedirs(broker_dir, exist_ok=True)
         t0 = time.time()
         user_ids = _publish_model(broker_dir, args.users, args.items,
-                                  args.features)
+                                  args.features,
+                                  sharded=args.sharded_publish)
         publish_s = round(time.time() - t0, 1)
         print(f"== published model stream in {publish_s}s ==",
               file=sys.stderr)
@@ -883,7 +1054,8 @@ def main(argv: list[str] | None = None) -> int:
                 overload_factor=args.overload_factor,
                 cache=args.cache,
                 zipf=args.zipf,
-                coalesce_burst=args.coalesce_burst)
+                coalesce_burst=args.coalesce_burst,
+                sharded_publish=args.sharded_publish)
             row["publish_s"] = publish_s
             rows.append(row)
             print(json.dumps({k: v for k, v in rows[-1].items()
@@ -899,6 +1071,8 @@ def main(argv: list[str] | None = None) -> int:
     report = {
         "metric": "gateway_recommend_scaling",
         "cache_armed": args.cache,
+        "sharded_publish": args.sharded_publish or None,
+        "load_compare": load_compare,
         "zipf_a": args.zipf or None,
         "tracing_sample": args.tracing_sample,
         "emulated_device_ms_per_mrow": args.device_ms_per_mrow,

@@ -303,26 +303,53 @@ class MembershipRegistry:
                 self._commit_locked(max(candidates))
         return self._merged_of
 
+    def _ranked_locked(self, live: list[Heartbeat], shard: int,
+                       of: int) -> list[Heartbeat]:
+        """One shard's ready candidates, ranked: newest generation
+        first, rotated by the shared round-robin counter so repeated
+        calls spread load; older-generation replicas stay at the tail
+        — a hedge may still fall back to them (stale beats dead), but
+        a replica mid-replay of a newer model is ranked behind its
+        peers.  THE single ranking definition: candidates() and
+        routing_plan() must never disagree on ordering."""
+        sl = [hb for hb in live
+              if hb.shard == shard and hb.ready and hb.of == of]
+        if not sl:
+            return []
+        top_gen = max(hb.generation for hb in sl)
+        newest = [hb for hb in sl if hb.generation == top_gen]
+        older = [hb for hb in sl if hb.generation != top_gen]
+        self._rr += 1
+        r = self._rr % len(newest)
+        older.sort(key=lambda hb: -hb.generation)
+        return newest[r:] + newest[:r] + older
+
     def candidates(self, shard: int) -> list[Heartbeat]:
         """Ready live replicas for a shard IN THE CURRENT TOPOLOGY —
-        the shard's replica group: newest generation first; within a
-        generation, rotated so repeated calls spread load."""
+        the shard's replica group (see _ranked_locked for the
+        ordering)."""
         with self._lock:
             of = self._topology_locked()
-            live = [hb for hb in self._live_locked()
-                    if hb.shard == shard and hb.ready and hb.of == of]
-            if not live:
-                return []
-            top_gen = max(hb.generation for hb in live)
-            newest = [hb for hb in live if hb.generation == top_gen]
-            older = [hb for hb in live if hb.generation != top_gen]
-            self._rr += 1
-            r = self._rr % len(newest)
-            # older-generation replicas stay at the tail: a hedge may
-            # still fall back to them (stale beats dead), but a replica
-            # mid-replay of a newer model is ranked behind its peers
-            older.sort(key=lambda hb: -hb.generation)
-            return newest[r:] + newest[:r] + older
+            return self._ranked_locked(self._live_locked(), shard, of)
+
+    def routing_plan(self) -> tuple[int, list[list[Heartbeat]]]:
+        """One CONSISTENT snapshot of (routed topology, per-shard ready
+        candidate lists) under a SINGLE lock acquisition — the scatter
+        fan-out's view of the cluster.  The per-shard ``candidates()``
+        calls each re-derive the topology, so a cutover landing between
+        two of them could hand one request shard 0 of the OLD ring and
+        shard 1 of the NEW one: overlapping catalogs merged as if
+        disjoint, a silently wrong 200 with no partial marker.  The
+        atomic-cutover contract ("a request routes either entirely old
+        or entirely new", module docstring) therefore requires the
+        whole plan to come from one locked read.  Ordering per shard
+        is _ranked_locked — the same definition ``candidates()``
+        uses."""
+        with self._lock:
+            of = self._topology_locked()
+            live = self._live_locked()
+            return of, [self._ranked_locked(live, shard, of)
+                        for shard in range(of)]
 
     def any_candidates(self) -> list[Heartbeat]:
         """Ready live replicas of ANY shard in the current topology

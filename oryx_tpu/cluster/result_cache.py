@@ -48,6 +48,15 @@ is evicted, or the next generation publish: the same freshness the
 speed layer itself gives untouched users (the residual-window
 argument in docs/SCALING.md).
 
+**Negative caching (hot 404s).**  An "unknown user/item" answer on the
+cacheable surface is cached as a NEGATIVE entry under the same
+generation/topology epoch (``oryx.cluster.cache.negative-enabled``):
+a hot missing id stops costing a full scatter per probe.  Eviction is
+the same precise UP feed — the fold-in that finally *creates* the
+user/item names it in an UP record, which evicts its 404 — and the
+``X-Oryx-Cache`` verdict semantics are unchanged (a cached 404 serves
+as ``hit``, re-rendered through the same error page as a cold one).
+
 **Single-flight coalescing.**  Concurrent requests with the same cache
 key latch onto one in-flight scatter: the first becomes the *leader*,
 followers wait on its flight and reuse the complete rendered result
@@ -147,15 +156,24 @@ class CacheEntry:
     case — it doubles as the leader's own response, so a hit is
     byte-identical to the miss that created it); the CSV and gzip
     variants render once on first demand and are charged to the byte
-    budget as they appear."""
+    budget as they appear.
+
+    A NEGATIVE entry (``status`` != 200 — the hot-404 cache) retains
+    only the error message: the dispatcher re-renders the error page
+    from it per request (byte-identical to a cold 404 by construction,
+    Accept negotiation included), so what the cache saves is the
+    scatter, and the ``X-Oryx-Cache`` verdict semantics are
+    unchanged."""
 
     __slots__ = ("key", "value", "variants", "bytes", "tags",
-                 "value_charge")
+                 "value_charge", "status")
 
-    def __init__(self, key: tuple, value, tags: tuple = ()):
+    def __init__(self, key: tuple, value, tags: tuple = (),
+                 status: int = 200):
         self.key = key
         self.value = value
         self.tags = tags
+        self.status = status
         # (kind, gzipped) -> (payload bytes, content type)
         self.variants: dict[tuple[str, bool], tuple[bytes, str]] = {}
         self.bytes = 0
@@ -207,6 +225,12 @@ class ResultCache:
         self.max_bytes = config.get_int(f"{c}.cache.max-bytes")
         self.coalesce_wait_sec = \
             config.get_int(f"{c}.coalesce.wait-ms") / 1000.0
+        # hot-404 negative caching (roadmap item 2 leftover): unknown
+        # user/item answers cached under the same epoch with the same
+        # precise UP eviction — the fold-in that CREATES the id evicts
+        # its 404
+        self.negative_enabled = config.get_bool(
+            f"{c}.cache.negative-enabled")
         self.quarantine_sec = config.get_int(
             f"{c}.cache.invalidation-quarantine-ms") / 1000.0
         if self.max_entries < 1 or self.max_bytes < 1:
@@ -237,6 +261,8 @@ class ResultCache:
         self.stale_feed_stalls = 0
         self.store_rejects = 0
         self.epoch_flushes = 0
+        self.negative_hits = 0
+        self.negative_stores = 0
 
     @classmethod
     def from_config(cls, config, metrics, registry) -> "ResultCache | None":
@@ -285,6 +311,9 @@ class ResultCache:
             self._entries.move_to_end(probe.key)
             self.hits += 1
             self._metrics.inc("cache_hits")
+            if entry.status != 200:
+                self.negative_hits += 1
+                self._metrics.inc("cache_negative_hits")
             return entry
 
     # -- store ---------------------------------------------------------------
@@ -336,6 +365,44 @@ class ResultCache:
             self._bytes += entry.bytes
             for tag in probe.tags:
                 self._by_tag.setdefault(tag, set()).add(probe.key)
+            self._evict_over_budget_locked()
+        return entry
+
+    def store_negative(self, probe: CacheProbe, status: int,
+                       message: str) -> CacheEntry | None:
+        """Offer a 404 from the cacheable surface (unknown user/item).
+        Same epoch key, same tag index, same fencing as :meth:`store`:
+        the UP record of the fold-in that finally CREATES the id
+        evicts its negative entry, so a hot missing id stops costing a
+        full scatter without ever outliving its own absence.  Returns
+        the entry for coalesced followers (a herd on a missing id
+        collapses to one scatter too), or None when negative caching
+        is off or the store is fenced."""
+        if status != 404 or not self.negative_enabled:
+            return None
+        if not (self.store_enabled or self.coalesce):
+            return None
+        if self._registry.generation_topology() != probe.epoch:
+            return None
+        entry = CacheEntry(probe.key, message, probe.tags, status=status)
+        # budget charge: the message plus per-entry bookkeeping — tiny
+        # next to rendered bodies, but never free
+        entry.bytes = len(message.encode("utf-8", "replace")) + 128
+        with self._lock:
+            if self._fenced_locked(probe):
+                self.store_rejects += 1
+                return None
+            if not self.store_enabled:
+                return entry  # coalesce-only: share, don't retain
+            old = self._entries.pop(probe.key, None)
+            if old is not None:
+                self._bytes -= old.bytes
+                self._unindex_locked(old)
+            self._entries[probe.key] = entry
+            self._bytes += entry.bytes
+            for tag in probe.tags:
+                self._by_tag.setdefault(tag, set()).add(probe.key)
+            self.negative_stores += 1
             self._evict_over_budget_locked()
         return entry
 
@@ -580,5 +647,8 @@ class ResultCache:
                 "stale_feed_stalls": self.stale_feed_stalls,
                 "store_rejects": self.store_rejects,
                 "epoch_flushes": self.epoch_flushes,
+                "negative_enabled": self.negative_enabled,
+                "negative_stores": self.negative_stores,
+                "negative_hits": self.negative_hits,
                 "in_flight": len(self._flights),
             }

@@ -431,7 +431,9 @@ class ScatterGather:
     def query_shard(self, shard: int, method: str, path: str,
                     body: bytes | None = None,
                     deadline: Deadline | None = None,
-                    parent_span=None) -> ShardResponse:
+                    parent_span=None,
+                    candidates: "list[Heartbeat] | None" = None
+                    ) -> ShardResponse:
         """Authoritative response from ``shard``, via hedged attempts
         over its live replicas; :class:`ShardUnavailable` when none
         answers within the deadline.
@@ -439,12 +441,15 @@ class ScatterGather:
         ``parent_span`` is the caller's request span when this call
         runs on a pool thread (scatter fan-out) where thread-local
         trace context does not follow; called inline on the handler
-        thread, the tracer's thread-current span is used."""
+        thread, the tracer's thread-current span is used.
+        ``candidates`` is the scatter fan-out's consistent routing-plan
+        slice (registry.routing_plan()); None re-reads the registry —
+        fine for single-shard callers like the Gramian fetch."""
         faults.fire("router-shard-timeout")
         span, tp = self._begin_shard_span(shard, parent_span)
         try:
             res = self._query_shard(shard, method, path, body, deadline,
-                                    tp)
+                                    tp, candidates=candidates)
         except BaseException:
             if span is not None:
                 span.end("error")
@@ -471,8 +476,11 @@ class ScatterGather:
 
     def _query_shard(self, shard: int, method: str, path: str,
                      body: bytes | None, deadline: Deadline | None,
-                     tp: str | None) -> ShardResponse:
-        candidates = self.registry.candidates(shard)
+                     tp: str | None,
+                     candidates: "list[Heartbeat] | None" = None
+                     ) -> ShardResponse:
+        if candidates is None:
+            candidates = self.registry.candidates(shard)
         if not candidates:
             with self._lock:
                 self.shard_failures += 1
@@ -578,8 +586,22 @@ class ScatterGather:
         ``paths`` is one path for all shards or a per-shard map.
         Returns (responses by shard, failed shards).  Raises
         ShardUnavailable only when EVERY queried shard failed."""
-        targets = range(self.registry.shard_count) \
-            if shards is None else shards
+        # ONE consistent routing snapshot for the whole fan-out: the
+        # topology and every shard's candidate list come from a single
+        # locked registry read, so a cutover mid-request can never mix
+        # two rings' shards into one merge (the atomic-cutover
+        # contract; a request in flight at the cutover instant routes
+        # entirely on the ring it started with)
+        of, plan = self.registry.routing_plan()
+        if shards is None:
+            targets = range(of)
+            plan_for = {s: plan[s] for s in targets}
+        else:
+            targets = shards
+            # explicit-shard callers (the Gramian cache) key their own
+            # state by (topology, shard, generation); candidates
+            # re-read per shard as before
+            plan_for = {s: None for s in targets}
         # trace context is captured HERE, on the requesting handler
         # thread — the per-shard queries run on pool threads where the
         # tracer's thread-local current span does not follow
@@ -589,7 +611,7 @@ class ScatterGather:
             s: self._exec.submit(
                 self.query_shard, s,
                 method, paths if isinstance(paths, str) else paths[s],
-                body, deadline, parent)
+                body, deadline, parent, plan_for[s])
             for s in targets}
         results: dict[int, ShardResponse] = {}
         failed: list[int] = []

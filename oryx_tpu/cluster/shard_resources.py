@@ -276,10 +276,16 @@ def _shard_vectors(req: Request):
 def _shard_yty(req: Request):
     """This shard's partial Gramian: sum over shards == the full-catalog
     YtY (row-disjoint slices), which the router feeds to the fold-in
-    solver for anonymous/context recommendations."""
+    solver for anonymous/context recommendations.  A slice-loaded
+    replica answers from the manifest's precomputed per-slice partials
+    (summed at load — no device scan) until a live Y write outdates
+    them; otherwise the store's one-matmul vtv runs."""
     model = _als_model(req)
     manager = _manager(req)
-    yty = model.Y.vtv()
+    precomputed = getattr(manager, "partial_yty", None)
+    yty = precomputed() if callable(precomputed) else None
+    if yty is None:
+        yty = model.Y.vtv()
     return _envelope(req, manager, features=model.features,
                      implicit=bool(model.implicit),
                      yty=[[float(x) for x in row] for row in yty])

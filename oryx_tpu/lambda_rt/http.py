@@ -520,6 +520,20 @@ class HttpApp:
             try:
                 result = route.handler(req)
             except OryxServingException as e:
+                if probe is not None and e.status == 404:
+                    # hot-404 negative caching: the unknown-user/item
+                    # answer joins the cache under the same epoch and
+                    # precise UP eviction (the fold-in that creates
+                    # the id evicts its 404); followers coalesced on
+                    # the missing key reuse it too
+                    published = self.result_cache.store_negative(
+                        probe, e.status, str(e))
+                    if flight is not None:
+                        self.result_cache.finish_flight(flight,
+                                                        published)
+                    self._send_error(handler, e.status, str(e),
+                                     headers={"X-Oryx-Cache": "miss"})
+                    return
                 self._send_error(handler, e.status, str(e))
                 return
             except DeadlineExceeded as e:
@@ -571,6 +585,14 @@ class HttpApp:
         """Serve a cached/coalesced entry: preserialized bytes, no
         json_or_csv, no gzip recompression (the stored gzip variant is
         reused as-is), stamped ``X-Oryx-Cache``."""
+        if entry.status != 200:
+            # negative entry (hot 404): re-render the SAME error page
+            # a cold miss renders — byte-identical by construction,
+            # Accept negotiation included; the saved work is the
+            # scatter, not the (tiny) render
+            self._send_error(handler, entry.status, entry.value,
+                             headers={"X-Oryx-Cache": verdict})
+            return
         accept = handler.headers.get("Accept", "")
         gzip_ok = "gzip" in handler.headers.get("Accept-Encoding", "")
         payload, ctype, gzipped = self.result_cache.render(

@@ -125,6 +125,20 @@ class ServingLayer:
                                        lambda: self._update_tap.consumed))
             self.metrics.gauge_fn("model_generation_age_sec",
                                   self._update_tap.model_age_sec)
+        # sharded model distribution (app/als/slices.py): how this
+        # replica loaded its model — seconds to servable, slice bytes
+        # read, and fallbacks to the monolithic artifacts.  Managers
+        # without the attributes (non-ALS apps) simply don't register.
+        if hasattr(self.model_manager, "model_load_s"):
+            mgr = self.model_manager
+            self.metrics.gauge_fn(
+                "model_load_s", lambda: float(mgr.model_load_s))
+            self.metrics.gauge_fn(
+                "model_slice_bytes",
+                lambda: float(mgr.model_slice_bytes))
+            self.metrics.gauge_fn(
+                "slice_load_fallbacks",
+                lambda: float(mgr.slice_load_fallbacks))
         # SLO burn-rate engine (obs/slo.py; None = disabled): evaluated
         # lazily whenever the gauges are read, alert state at /admin/slo
         self.slo_engine = engine_from_config(config, self.metrics)

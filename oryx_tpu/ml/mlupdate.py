@@ -89,6 +89,19 @@ class MLUpdate(BatchLayerUpdate, abc.ABC):
     def can_publish_additional_model_data(self) -> bool:
         return False
 
+    def prepare_model_ref_payload(self, model: Element | None,
+                                  model_path: str,
+                                  new_data: Sequence[KeyMessage],
+                                  past_data: Sequence[KeyMessage]) -> str:
+        """The MODEL-REF message payload for a too-large-to-inline
+        model.  The default is the reference contract — the bare
+        storage path of the PMML file.  Apps with a sharded
+        distribution story (ALS) override to write per-slice artifacts
+        next to the model and return a manifest-carrying envelope
+        (app/als/slices.py), so consumers bulk-load their slice
+        instead of replaying a full UP stream."""
+        return model_path
+
     def publish_additional_model_data(self, model: Element,
                                       new_data: Sequence[KeyMessage],
                                       past_data: Sequence[KeyMessage],
@@ -157,7 +170,11 @@ class MLUpdate(BatchLayerUpdate, abc.ABC):
                 if not_too_large:
                     model_update_topic.send(KEY_MODEL, pmml_io.to_string(best_model))
                 else:
-                    model_update_topic.send(KEY_MODEL_REF, best_model_path)
+                    model_update_topic.send(
+                        KEY_MODEL_REF,
+                        self.prepare_model_ref_payload(
+                            best_model, best_model_path, new_data,
+                            past_data))
                 if needed:
                     self.publish_additional_model_data(
                         best_model, new_data, past_data, final_path,

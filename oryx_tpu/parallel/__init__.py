@@ -12,6 +12,7 @@ from .mesh import build_mesh, local_mesh
 from .als_dist import (
     BlockedRatings,
     block_ratings,
+    block_ratings_ring,
     make_train_step,
     train_als_distributed,
 )
@@ -21,6 +22,7 @@ __all__ = [
     "local_mesh",
     "BlockedRatings",
     "block_ratings",
+    "block_ratings_ring",
     "make_train_step",
     "train_als_distributed",
 ]

@@ -22,6 +22,23 @@ This scales the memory of the blocked interaction layout and the solve
 FLOPs linearly with devices; the all-gathered opposite factor is the
 same replicate-the-smaller-side tradeoff MLlib makes with its block
 broadcast.
+
+Multi-host path (``mode="ring"``, the default when the mesh spans
+processes): the all-gather + serialized psum become a **ring
+half-sweep** — the opposite factor's row blocks rotate around the mesh
+axis via ``lax.ppermute`` while each device accumulates the partial
+normal equations for the interactions whose columns live in the
+resident block (the interactions are pre-split per (row, owner-block)
+on host, so total einsum slots stay ~P — no n_dev× FLOP blow-up).  The
+Gramian accumulates per hop from the resident block, so the "psum" is
+interleaved with — not serialized after — the per-row solve build, and
+the full opposite factor is NEVER materialized on any device: peak
+memory per half-sweep is one rotating block (rows/n_dev × k) instead
+of the whole matrix.  Over DCN (multi-host) this is the difference
+between overlapping each hop's transfer with a block's worth of MXU
+work and stalling the whole step behind one all-gather.  Factor
+buffers are donated to the jitted step (X/Y updated in place across
+iterations) on backends that support donation.
 """
 
 from __future__ import annotations
@@ -45,8 +62,8 @@ from ..app.als.common import ParsedRatings
 from ..app.als.trainer import ALSModel, _solve_batch
 from ..common.rand import RandomManager
 
-__all__ = ["BlockedRatings", "block_ratings", "make_train_step",
-           "train_als_distributed"]
+__all__ = ["BlockedRatings", "block_ratings", "block_ratings_ring",
+           "make_train_step", "train_als_distributed"]
 
 
 class BlockedRatings(NamedTuple):
@@ -69,6 +86,10 @@ class BlockedRatings(NamedTuple):
 
 def _pad_rows(n: int, n_dev: int) -> int:
     return max(n_dev, ((n + n_dev - 1) // n_dev) * n_dev)
+
+
+def _next_pow2(x: int) -> int:
+    return 1 << max(0, (x - 1).bit_length())
 
 
 def _dense_block(rows: np.ndarray, cols: np.ndarray, vals: np.ndarray,
@@ -102,15 +123,72 @@ def block_ratings(ratings: ParsedRatings, n_devices: int) -> BlockedRatings:
                           u_cols, u_vals, u_mask, i_cols, i_vals, i_mask)
 
 
+def _owner_block(rows: np.ndarray, cols: np.ndarray, vals: np.ndarray,
+                 n_rows_pad: int, block_rows: int, n_dev: int
+                 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Per-(row, owner-block) padded layout for the ring half-sweep:
+    slot (r, b, :) holds row r's interactions whose opposite index
+    lives in block b, as LOCAL indices within the block.  Total real
+    slots equal the dense layout's — the ring schedule then touches
+    each interaction exactly once (at the hop its block is resident),
+    so the per-row-solve FLOPs match the all-gather path instead of
+    multiplying by n_dev."""
+    owner = cols // block_rows
+    key = rows.astype(np.int64) * n_dev + owner
+    order = np.argsort(key, kind="stable")
+    key_s = key[order]
+    counts = np.bincount(key_s, minlength=n_rows_pad * n_dev)
+    p = _next_pow2(max(1, int(counts.max(initial=1))))
+    # within-group slot index, vectorized (groups are contiguous in
+    # key order)
+    starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    slot = np.arange(len(key_s), dtype=np.int64) - starts[key_s]
+    bcols = np.zeros((n_rows_pad * n_dev, p), dtype=np.int32)
+    bvals = np.zeros((n_rows_pad * n_dev, p), dtype=np.float32)
+    bmask = np.zeros((n_rows_pad * n_dev, p), dtype=np.float32)
+    bcols[key_s, slot] = (cols[order] - owner[order] * block_rows
+                          ).astype(np.int32)
+    bvals[key_s, slot] = vals[order]
+    bmask[key_s, slot] = 1.0
+    shape = (n_rows_pad, n_dev, p)
+    return bcols.reshape(shape), bvals.reshape(shape), bmask.reshape(shape)
+
+
+def block_ratings_ring(ratings: ParsedRatings,
+                       n_devices: int) -> BlockedRatings:
+    """The ring half-sweep's layout: same six arrays as
+    :func:`block_ratings` but shaped ``(rows_pad, n_dev, P_block)`` —
+    slab ``[:, b, :]`` is the interactions resolved while block ``b``
+    of the opposite factor is resident on this device."""
+    n_users = len(ratings.user_ids)
+    n_items = len(ratings.item_ids)
+    nu_pad = _pad_rows(n_users, n_devices)
+    ni_pad = _pad_rows(n_items, n_devices)
+    u = _owner_block(ratings.users, ratings.items, ratings.values,
+                     nu_pad, ni_pad // n_devices, n_devices)
+    i = _owner_block(ratings.items, ratings.users, ratings.values,
+                     ni_pad, nu_pad // n_devices, n_devices)
+    return BlockedRatings(n_users, n_items, *u, *i)
+
+
 def make_train_step(mesh: Mesh, lam: float, alpha: float, implicit: bool,
-                    axis: str = "d"):
+                    axis: str = "d", mode: str = "gather",
+                    donate: bool | None = None):
     """Build the jitted distributed step: (X, Y, blocks…) -> (X', Y').
 
     All array arguments are expected sharded with PartitionSpec((axis,))
-    on their leading (row) dimension.
-    """
+    on their leading (row) dimension — blocks from :func:`block_ratings`
+    for ``mode="gather"``, :func:`block_ratings_ring` for
+    ``mode="ring"`` (the multi-host layout: per-row solves overlapped
+    with the Gramian reduction, no materialized full opposite factor).
 
-    def _half(opposite_local, cols, vals, mask):
+    ``donate`` donates the X/Y factor buffers to the step so iterations
+    update HBM in place; None = donate wherever the backend supports it
+    (CPU's donation is a no-op warning, so tests opt in explicitly).
+    """
+    n_dev = int(mesh.devices.size)
+
+    def _half_gather(opposite_local, cols, vals, mask):
         # collectives: gather the opposite factor over ICI; Gramian by
         # psum of local partials (only needed for the implicit base term
         # but cheap either way, and it keeps one code path)
@@ -126,9 +204,61 @@ def make_train_step(mesh: Mesh, lam: float, alpha: float, implicit: bool,
         n = jnp.sum(mask, axis=1)
         return jnp.where((n > 0.0)[:, None], x, 0.0)
 
+    def _half_ring(opposite_local, cols_b, vals_b, mask_b):
+        """One ring half-sweep: the opposite factor's blocks rotate via
+        ppermute; each hop folds the resident block's interactions into
+        the accumulating normal equations AND the Gramian, so the
+        communication of hop t+1 overlaps the einsum of hop t (XLA
+        async collectives) instead of the whole solve waiting on an
+        all-gather + psum.  Padding slots carry zero mask/vals and
+        clamp their gathers to row 0 — they contribute exact zeros,
+        the same contract as the dense layout."""
+        k = opposite_local.shape[1]
+        d = jax.lax.axis_index(axis)
+        rows_local = cols_b.shape[0]
+        n_u = jnp.sum(mask_b, axis=(1, 2))
+        A = jnp.zeros((rows_local, k, k), dtype=jnp.float32)
+        b = jnp.zeros((rows_local, k), dtype=jnp.float32)
+        G = jnp.zeros((k, k), dtype=jnp.float32)
+        alpha32 = jnp.float32(alpha)
+        block = opposite_local
+        perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+        for t in range(n_dev):
+            # device d holds block (d - t) mod n_dev at hop t
+            j = jax.lax.rem(d - t + n_dev, n_dev)
+            cols = jnp.take(cols_b, j, axis=1)
+            vals = jnp.take(vals_b, j, axis=1)
+            mask = jnp.take(mask_b, j, axis=1)
+            if implicit:
+                w = alpha32 * jnp.abs(vals) * mask
+                tt = (1.0 + w) * (vals > 0.0)
+            else:
+                w = mask
+                tt = vals * mask
+            Yg = block[cols]  # (rows_local, Pb, k)
+            A = A + jnp.einsum("bpk,bpl->bkl", Yg * w[:, :, None], Yg,
+                               preferred_element_type=jnp.float32)
+            b = b + jnp.einsum("bpk,bp->bk", Yg, tt,
+                               preferred_element_type=jnp.float32)
+            if implicit:
+                # the Gramian's block-j term, computed while block j is
+                # HERE — the all-reduce dissolves into the ring
+                G = G + jnp.matmul(block.T, block,
+                                   preferred_element_type=jnp.float32)
+            if t < n_dev - 1:
+                block = jax.lax.ppermute(block, axis, perm)
+        if implicit:
+            A = A + G[None, :, :]
+        A = A + (lam * jnp.maximum(n_u, 1.0))[:, None, None] * \
+            jnp.eye(k, dtype=A.dtype)[None]
+        x = jnp.linalg.solve(A, b[..., None])[..., 0]
+        return jnp.where((n_u > 0.0)[:, None], x, 0.0)
+
+    half = {"gather": _half_gather, "ring": _half_ring}[mode]
+
     def _step(X, Y, u_cols, u_vals, u_mask, i_cols, i_vals, i_mask):
-        X = _half(Y, u_cols, u_vals, u_mask)
-        Y = _half(X, i_cols, i_vals, i_mask)
+        X = half(Y, u_cols, u_vals, u_mask)
+        Y = half(X, i_cols, i_vals, i_mask)
         return X, Y
 
     spec = P(axis)
@@ -136,21 +266,33 @@ def make_train_step(mesh: Mesh, lam: float, alpha: float, implicit: bool,
         _step, mesh=mesh,
         in_specs=(spec,) * 8,
         out_specs=(spec, spec))
-    return jax.jit(sharded)
+    if donate is None:
+        donate = jax.default_backend() not in ("cpu",)
+    return jax.jit(sharded, donate_argnums=(0, 1) if donate else ())
 
 
 def train_als_distributed(ratings: ParsedRatings, features: int, lam: float,
                           alpha: float, implicit: bool, iterations: int,
                           mesh: Mesh, seed: int | None = None,
-                          axis: str = "d") -> ALSModel:
-    """Full multi-device ALS training loop; returns host-side factors."""
+                          axis: str = "d", mode: str = "auto",
+                          donate: bool | None = None) -> ALSModel:
+    """Full multi-device ALS training loop; returns host-side factors.
+
+    ``mode``: "gather" (all_gather + psum — the single-host default),
+    "ring" (ppermute ring with the Gramian reduction overlapped into
+    the per-row-solve build — the multi-host path), or "auto" = ring
+    exactly when the mesh spans processes (DCN hops are where the
+    overlap pays; within one host's ICI the all-gather is cheap)."""
     n_dev = mesh.devices.size
     k = features
+    if mode == "auto":
+        mode = "ring" if jax.process_count() > 1 else "gather"
     if len(ratings.user_ids) == 0 or len(ratings.item_ids) == 0:
         return ALSModel(ratings.user_ids, ratings.item_ids,
                         np.zeros((0, k), np.float32),
                         np.zeros((0, k), np.float32))
-    blocks = block_ratings(ratings, n_dev)
+    blocks = (block_ratings_ring(ratings, n_dev) if mode == "ring"
+              else block_ratings(ratings, n_dev))
 
     if seed is None:
         if jax.process_count() > 1:
@@ -173,7 +315,8 @@ def train_als_distributed(ratings: ParsedRatings, features: int, lam: float,
     X, Y = put(X0), put(Y0)
     args = tuple(put(a) for a in (blocks.u_cols, blocks.u_vals, blocks.u_mask,
                                   blocks.i_cols, blocks.i_vals, blocks.i_mask))
-    step = make_train_step(mesh, lam, alpha, implicit, axis)
+    step = make_train_step(mesh, lam, alpha, implicit, axis, mode=mode,
+                           donate=donate)
     for _ in range(iterations):
         X, Y = step(X, Y, *args)
     if jax.process_count() > 1:

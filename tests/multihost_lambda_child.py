@@ -65,6 +65,11 @@ def main() -> None:
         "oryx.als.iterations": 3,
         "oryx.ml.eval.test-fraction": 0.0,
         "oryx.ml.eval.candidates": 1,
+        # force the MODEL-REF path (the PMML exceeds this) so the run
+        # proves SHARDED publish end-to-end: the batch layer writes
+        # murmur2 slices + a manifest-carrying envelope, skips the
+        # per-row UP flood, and serving/speed bulk-load their slices
+        "oryx.update-topic.message.max-size": 512,
     })
     try:
         joined = initialize_multihost(cfg)
@@ -102,7 +107,16 @@ def main() -> None:
         msgs = list(broker.consume("MhUp", from_beginning=True,
                                    max_idle_sec=0.2))
         keys = [m.key for m in msgs]
-        assert KEY_MODEL in keys or KEY_MODEL_REF in keys, keys[:3]
+        assert KEY_MODEL_REF in keys, keys[:3]
+        # sharded publish: the MODEL-REF record carries the manifest
+        # and NO per-row UP flood follows it (slices replace it)
+        ref = next(m.message for m in msgs if m.key == KEY_MODEL_REF)
+        from oryx_tpu.app.als.slices import parse_model_ref
+        _, _, manifest = parse_model_ref(ref)
+        assert manifest is not None and manifest["ring"] >= 1, ref[:80]
+        assert not any(m.key == "UP" for m in msgs), \
+            "sharded publish must skip the Y/X UP stream"
+        payload["manifest_ring"] = manifest["ring"]
 
         import time
         import urllib.request
@@ -126,6 +140,12 @@ def main() -> None:
                 recs = json.loads(r.read())
             assert len(recs) == 3 and all("id" in x for x in recs), recs
             payload["recommend_ids"] = [x["id"] for x in recs]
+            # the serving model came from SLICE bulk loads, not replay
+            mgr = serving.model_manager
+            assert mgr.slice_loads > 0, "expected a slice load"
+            assert mgr.slice_load_fallbacks == 0
+            payload["slice_loads"] = mgr.slice_loads
+            payload["model_load_s"] = mgr.model_load_s
 
             # -- speed fold-in leg: SpeedLayer loads the SAME published
             # model, folds a micro-batch for a user the batch layer

@@ -330,6 +330,56 @@ def test_check_regression_gateway_zipf_cell_back_compat(tmp_path,
     assert not report["regressions"]
 
 
+def test_check_regression_gateway_load_cell_gates_on_load_speed(
+        tmp_path, capsys):
+    """The r12 model-load telemetry gates as its own pseudo-cell on
+    1/model_load_s: a slice-load regression (load time blowing back up
+    toward the full-replay cost) fails the gate even when the cold qps
+    cell held."""
+    prev = _gateway_doc([(50, 65536, 2, 100.0)])
+    prev["rows"][0]["model_load"] = {"mode": "slices",
+                                     "max_replica_load_s": 5.0}
+    cur = _gateway_doc([(50, 65536, 2, 101.0)])
+    cur["rows"][0]["model_load"] = {"mode": "slices",
+                                    "max_replica_load_s": 20.0}
+    rc = cr.main(["--kind", "gateway",
+                  "--previous", _write(tmp_path,
+                                       "BENCH_GATEWAY_r11.json", prev),
+                  "--current", _write(tmp_path,
+                                      "BENCH_GATEWAY_r12.json", cur)])
+    assert rc == 1
+    report = json.loads(capsys.readouterr().out)
+    assert [c["cell"] for c in report["regressions"]] == \
+        ["50f/0.065536M/2rep/load"]
+    # and a faster load gates green (reported improved, never failed)
+    cur["rows"][0]["model_load"]["max_replica_load_s"] = 2.0
+    rc = cr.main(["--kind", "gateway",
+                  "--previous", _write(tmp_path,
+                                       "BENCH_GATEWAY_r11.json", prev),
+                  "--current", _write(tmp_path,
+                                      "BENCH_GATEWAY_r12.json", cur)])
+    assert rc == 0
+
+
+def test_check_regression_gateway_load_cell_back_compat(tmp_path,
+                                                        capsys):
+    """r07/r09/r11 artifacts carry no model_load block: the load
+    pseudo-cell is reported as new, never gated against them."""
+    prev = _gateway_doc([(50, 65536, 2, 100.0)])           # r11 shape
+    cur = _gateway_doc([(50, 65536, 2, 99.0)])
+    cur["rows"][0]["model_load"] = {"mode": "slices",
+                                    "max_replica_load_s": 4.2}
+    rc = cr.main(["--kind", "gateway",
+                  "--previous", _write(tmp_path,
+                                       "BENCH_GATEWAY_r11.json", prev),
+                  "--current", _write(tmp_path,
+                                      "BENCH_GATEWAY_r12.json", cur)])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["new_cells"] == ["(50, 65536, 2, 1, 'load')"]
+    assert not report["regressions"]
+
+
 def test_check_regression_gateway_discovers_rounds_and_skips_cross_backend(
         tmp_path, capsys):
     _write(tmp_path, "BENCH_GATEWAY_r07.json",

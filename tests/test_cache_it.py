@@ -566,3 +566,52 @@ def test_cold_router_404s_admin_cache(cluster):
     with pytest.raises(urllib.error.HTTPError) as e:
         _raw_get(cluster["cold"].port, "/admin/cache")
     assert e.value.code == 404
+
+
+def _raw_get_any(port, path, headers=None, timeout=15):
+    """_raw_get that returns error responses instead of raising — the
+    negative-caching assertions inspect 404 headers/bodies."""
+    try:
+        return _raw_get(port, path, headers=headers, timeout=timeout)
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+def test_hot_404_is_negative_cached_until_the_user_is_created(cluster):
+    """Negative caching (ISSUE 10 satellite): an unknown user's 404 is
+    cached — the second probe never scatters — and the fold-in that
+    CREATES the user evicts it, after which the user serves real
+    rows."""
+    cached, cold, speed = (cluster["cached"], cluster["cold"],
+                           cluster["speed"])
+    _flush(cached)
+    ghost = "ghost-user-404"
+    path = f"/recommend/{ghost}?howMany=5"
+    s, h, body = _raw_get_any(cached.port, path)
+    assert s == 404 and _verdict(h) == "miss"
+    stats0 = _cache_stats(cached)
+    s2, h2, body2 = _raw_get_any(cached.port, path)
+    assert s2 == 404 and _verdict(h2) == "hit"
+    assert body2 == body  # the error page re-renders byte-identically
+    stats1 = _cache_stats(cached)
+    assert stats1["negative_hits"] == stats0["negative_hits"] + 1
+    # misses did NOT move on the hit: no scatter happened
+    assert stats1["misses"] == stats0["misses"]
+
+    # now CREATE the user through the real write path: /pref -> input
+    # topic -> speed micro-batch -> UP X record for the new user
+    item = cluster["items"][0]
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{cached.port}/pref/{ghost}/{item}",
+        data=b"5.0", method="POST")
+    with urllib.request.urlopen(req, timeout=15) as r:
+        assert r.status in (200, 204)
+    speed.run_one_micro_batch()
+    # replicas absorb the new user; the tap's UP eviction kills the 404
+    _await(lambda: _raw_get_any(cold.port, path)[0] == 200,
+           "replicas absorbed the new user")
+    _await(lambda: _raw_get_any(cached.port, path)[0] == 200,
+           "negative entry evicted by the creating UP record")
+    s, h, rows = _raw_get_any(cached.port, path)
+    assert s == 200 and _verdict(h) in ("miss", "hit")
+    assert json.loads(rows)  # real recommendations now

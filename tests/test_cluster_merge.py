@@ -194,6 +194,35 @@ def test_manager_ignores_heartbeat_key():
         mgr.consume_key_message("BOGUS", "x")
 
 
+def test_routing_plan_is_one_consistent_snapshot():
+    """The scatter fan-out must see ONE topology: routing_plan returns
+    (of, per-shard candidates) from a single locked read — per-shard
+    candidates() calls each re-derive the topology, and a cutover
+    landing between two of them could merge shards of two different
+    rings in one request (overlapping catalogs, no partial marker)."""
+    reg = MembershipRegistry(ttl_sec=10.0, clock=_Clock())
+    reg.note(_hb("a", 0, of=2))
+    reg.note(_hb("b", 1, of=2))
+    of, plan = reg.routing_plan()
+    assert of == 2
+    assert [hb.replica for hb in plan[0]] == ["a"]
+    assert [hb.replica for hb in plan[1]] == ["b"]
+    # the plan cuts over atomically: the moment a declared 3-way
+    # target is fully ready, ONE plan is entirely 3-way (and the next
+    # ones too) — never a 2/3 hybrid
+    reg.begin_reshard(3)
+    for s in range(3):
+        reg.note(_hb(f"n{s}", s, of=3))
+    of2, plan2 = reg.routing_plan()
+    assert of2 == 3 and len(plan2) == 3
+    assert all(hb.of == 3 for sl in plan2 for hb in sl)
+    # rotation spreads load within the newest generation, same
+    # contract as candidates()
+    reg.note(_hb("n0b", 0, of=3))
+    first = {reg.routing_plan()[1][0][0].replica for _ in range(6)}
+    assert first == {"n0", "n0b"}
+
+
 # -- the merge property tests ------------------------------------------------
 
 def _manager(shard_spec: str, rescorer_provider=None) -> ALSServingModelManager:

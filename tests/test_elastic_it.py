@@ -39,7 +39,7 @@ from oryx_tpu.bench.gateway import (_await, _free_port, _get_json,
                                     _spawn, _write_conf)
 from oryx_tpu.cluster.sharding import shard_of
 from oryx_tpu.common import pmml as pmml_io
-from oryx_tpu.kafka.api import KEY_MODEL, KEY_UP
+from oryx_tpu.kafka.api import KEY_MODEL_REF
 from oryx_tpu.kafka.inproc import resolve_broker
 
 pytestmark = pytest.mark.chaos
@@ -59,7 +59,15 @@ _FAST = {
 }
 
 
-def _publish_model(broker_dir: str) -> None:
+def _publish_model(broker_dir: str, work_dir: str) -> None:
+    """SHARDED publish (ISSUE 10): a manifest-carrying MODEL-REF whose
+    murmur2 slices live in the shared store, and NO per-row UP flood —
+    so every replica in this IT (including the 2→3 reshard's warming
+    fleet) loads from slices + the topic tail, never a full-stream
+    replay.  The ring (24) is divisible by both topologies this IT
+    walks (2 and 3)."""
+    from oryx_tpu.app.als import slices as model_slices
+
     broker = resolve_broker(f"file://{broker_dir}")
     rng = np.random.default_rng(11)
     doc = pmml_io.build_skeleton_pmml()
@@ -67,16 +75,25 @@ def _publish_model(broker_dir: str) -> None:
     pmml_io.add_extension(doc, "implicit", True)
     pmml_io.add_extension_content(doc, "XIDs", _USERS)
     pmml_io.add_extension_content(doc, "YIDs", _ITEMS)
-    broker.send("GwUp", KEY_MODEL, pmml_io.to_string(doc))
-    for iid in _ITEMS:
-        broker.send("GwUp", KEY_UP, json.dumps(
-            ["Y", iid,
-             [round(float(x), 3) for x in rng.standard_normal(_FEATURES)]]))
-    for uid in _USERS:
-        broker.send("GwUp", KEY_UP, json.dumps(
-            ["X", uid,
-             [round(float(x), 3) for x in rng.standard_normal(_FEATURES)],
-             []]))
+    model_dir = os.path.join(work_dir, "model-gen1")
+    os.makedirs(model_dir, exist_ok=True)
+    pmml_path = os.path.join(model_dir, "model.pmml.xml")
+    pmml_io.write(doc, pmml_path)
+    Y = np.round(rng.standard_normal((len(_ITEMS), _FEATURES)), 3
+                 ).astype(np.float32)
+    X = np.round(rng.standard_normal((len(_USERS), _FEATURES)), 3
+                 ).astype(np.float32)
+    # monolithic artifacts alongside the slices — the production
+    # layout, so a fail-closed load would degrade instead of hanging
+    # (the IT still asserts the warm path took slices, zero fallbacks)
+    from oryx_tpu.app.als.update import save_features
+    save_features(os.path.join(model_dir, "Y"), _ITEMS, Y)
+    save_features(os.path.join(model_dir, "X"), _USERS, X)
+    slim = model_slices.publish_sliced(model_dir, _ITEMS, Y, _USERS, X,
+                                       None, 24)
+    broker.send("GwUp", KEY_MODEL_REF,
+                model_slices.model_ref_message(pmml_path, model_dir,
+                                               slim))
     broker.close()
 
 
@@ -197,7 +214,7 @@ def cluster(tmp_path_factory):
     work = tmp_path_factory.mktemp("elastic-it")
     broker_dir = str(work / "broker")
     os.makedirs(broker_dir)
-    _publish_model(broker_dir)
+    _publish_model(broker_dir, str(work))
     c = _Cluster(str(work), broker_dir)
     try:
         # shard 0 is a 2-way replica GROUP; shard 1 single-member
@@ -272,6 +289,15 @@ def test_02_live_reshard_2_to_3_under_continuous_load(cluster):
     assert snap["shards"] == 3
     assert all(r["of"] == 3 for r in snap["replicas"].values())
     assert snap["topology_cutovers"] == 1
+    # the warming fleet loaded from SLICES, not a full-stream replay:
+    # every new replica shows slice bytes read, a stamped load clock,
+    # and zero fallbacks to the monolithic artifacts (ISSUE 10
+    # acceptance — reshard warmup is slices + topic tail)
+    for s in range(3):
+        g = _get_json(c.procs[f"n{s}"][1], "/metrics")["freshness"]
+        assert g.get("slice_load_fallbacks") == 0, (s, g)
+        assert g.get("model_slice_bytes", 0) > 0, (s, g)
+        assert g.get("model_load_s", 0) > 0, (s, g)
     # step 4: retire the old fleet — answers stay exact and complete
     c.kill("a1")
     c.kill("b")

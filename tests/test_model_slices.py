@@ -1,0 +1,512 @@
+"""Sharded model distribution (ISSUE 10): slice partitioning property
+tests on the test_cluster_merge oracle harness, slice-loaded vs
+replay-loaded byte-identity across the serving surface, the
+``store-slice-missing`` chaos point's fail-closed fallback, ring
+compatibility, envelope back-compat, and the batch publisher's
+end-to-end manifest publish.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from oryx_tpu.app.als import slices
+from oryx_tpu.app.als.serving_manager import ALSServingModelManager
+from oryx_tpu.app.als.speed import ALSSpeedModelManager
+from oryx_tpu.cluster.merge import exact_local_top_n, merge_top_n
+from oryx_tpu.cluster.sharding import is_local_item, shard_of
+from oryx_tpu.common import pmml as pmml_io
+from oryx_tpu.common.config import from_dict
+from oryx_tpu.kafka.api import KEY_MODEL, KEY_MODEL_REF, KEY_UP, KeyMessage
+from oryx_tpu.resilience import faults
+
+FEATURES = 4
+RING = 24
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _grid_vec(rng) -> list[float]:
+    """Vectors on a coarse grid (multiples of 1/4): every dot product
+    is exact in float32, so byte-identity claims are deterministic —
+    the same trick as test_cluster_merge."""
+    return [float(x) / 4.0 for x in rng.integers(-8, 9, FEATURES)]
+
+
+def _catalog(rng, n_items=120, n_users=10, distinct=14):
+    pool = [_grid_vec(rng) for _ in range(distinct)]
+    y_ids = [f"i{j}" for j in range(n_items)]
+    x_ids = [f"u{j}" for j in range(n_users)]
+    Y = np.asarray([pool[int(rng.integers(0, distinct))]
+                    for _ in y_ids], dtype=np.float32)
+    X = np.asarray([_grid_vec(rng) for _ in x_ids], dtype=np.float32)
+    known = {u: sorted(y_ids[k] for k in
+                       rng.choice(n_items, size=5, replace=False))
+             for u in x_ids}
+    return y_ids, Y, x_ids, X, known
+
+
+def _publish(tmp_path, y_ids, Y, x_ids, X, known, ring=RING):
+    model_dir = str(tmp_path / "model")
+    os.makedirs(model_dir, exist_ok=True)
+    doc = pmml_io.build_skeleton_pmml()
+    pmml_io.add_extension(doc, "features", FEATURES)
+    pmml_io.add_extension(doc, "implicit", True)
+    pmml_io.add_extension_content(doc, "XIDs", x_ids)
+    pmml_io.add_extension_content(doc, "YIDs", y_ids)
+    pmml_path = model_dir + "/model.pmml.xml"
+    pmml_io.write(doc, pmml_path)
+    slim = slices.publish_sliced(model_dir, y_ids, Y, x_ids, X, known,
+                                 ring)
+    return (model_dir, pmml_path, slim,
+            slices.model_ref_message(pmml_path, model_dir, slim))
+
+
+def _manager(spec: str) -> ALSServingModelManager:
+    return ALSServingModelManager(from_dict({
+        "oryx.serving.model-manager-class": "unused",
+        "oryx.cluster.enabled": True,
+        "oryx.cluster.shard": spec,
+        "oryx.input-topic.broker": None,
+        "oryx.update-topic.broker": None,
+    }))
+
+
+def _replay_manager(spec, y_ids, Y, x_ids, X, known):
+    """The OLD distribution: inline MODEL + the full per-row UP stream
+    rendered exactly as ALSUpdate.publish_additional_model_data
+    renders it — the reference baseline every slice-loaded replica
+    must be byte-identical to."""
+    mgr = _manager(spec)
+    doc = pmml_io.build_skeleton_pmml()
+    pmml_io.add_extension(doc, "features", FEATURES)
+    pmml_io.add_extension(doc, "implicit", True)
+    pmml_io.add_extension_content(doc, "XIDs", x_ids)
+    pmml_io.add_extension_content(doc, "YIDs", y_ids)
+    mgr.consume_key_message(KEY_MODEL, pmml_io.to_string(doc))
+    for iid, row in zip(y_ids, Y):
+        mgr.consume_key_message(KEY_UP, json.dumps(
+            ["Y", iid, [float(v) for v in row]]))
+    for uid, row in zip(x_ids, X):
+        mgr.consume_key_message(KEY_UP, json.dumps(
+            ["X", uid, [float(v) for v in row], known.get(uid, [])]))
+    return mgr
+
+
+# -- slice partitioning properties -------------------------------------------
+
+@pytest.mark.parametrize("shards", [1, 2, 3, 4])
+def test_slices_partition_the_catalog_exactly(tmp_path, shards):
+    rng = np.random.default_rng(100 + shards)
+    y_ids, Y, x_ids, X, known = _catalog(rng)
+    _, _, slim, msg = _publish(tmp_path, y_ids, Y, x_ids, X, known)
+    assert slim["ring"] == RING and "gramians" not in slim
+    mgrs = [_manager(f"{s}/{shards}") for s in range(shards)]
+    for m in mgrs:
+        m.consume_key_message(KEY_MODEL_REF, msg)
+        assert m.slice_load_fallbacks == 0
+        assert m.slice_loads == RING // shards
+    held = [set(m.model.Y.all_ids()) for m in mgrs]
+    # pairwise disjoint, union == catalog, each shard exactly its
+    # murmur2 cut
+    for a in range(shards):
+        for b in range(a + 1, shards):
+            assert held[a].isdisjoint(held[b])
+        assert held[a] == {i for i in y_ids
+                           if is_local_item(i, a, shards)}
+    assert set().union(*held) == set(y_ids)
+    # the user store and known-items are FULL on every shard
+    for m in mgrs:
+        assert len(m.model.X) == len(x_ids)
+        assert m.model.get_known_items(x_ids[0]) == set(known[x_ids[0]])
+        assert m.model.get_fraction_loaded() == 1.0
+
+
+@pytest.mark.parametrize("shards", [2, 3])
+def test_per_slice_gramians_sum_to_full_yty(tmp_path, shards):
+    """Sum over every shard's partial_yty == the full catalog YtY of
+    the float32 rows consumers hold, within the docs/NUMERICS.md
+    row-partition bound (f64 accumulation, reassociation only)."""
+    rng = np.random.default_rng(7 + shards)
+    y_ids, Y, x_ids, X, known = _catalog(rng, n_items=200)
+    _, _, _, msg = _publish(tmp_path, y_ids, Y, x_ids, X, known)
+    mgrs = [_manager(f"{s}/{shards}") for s in range(shards)]
+    for m in mgrs:
+        m.consume_key_message(KEY_MODEL_REF, msg)
+    total = sum(m.partial_yty() for m in mgrs)
+    want = Y.astype(np.float64).T @ Y.astype(np.float64)
+    np.testing.assert_allclose(total, want, rtol=1e-9, atol=1e-9)
+    # and it matches what a device scan of the loaded store reports
+    scan = sum(np.asarray(m.model.Y.vtv(), dtype=np.float64)
+               for m in mgrs)
+    np.testing.assert_allclose(total, scan, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("shards", [2, 3])
+def test_slice_loaded_replica_is_byte_identical_to_replay_loaded(
+        tmp_path, shards):
+    """The acceptance property: a slice-loaded shard answers
+    byte-identically (ids, scores, ordinals — and therefore every
+    rendered response) to a replica that replayed the full UP stream,
+    and the merged cluster answer equals the full single-node one."""
+    rng = np.random.default_rng(40 + shards)
+    y_ids, Y, x_ids, X, known = _catalog(rng)
+    _, _, _, msg = _publish(tmp_path, y_ids, Y, x_ids, X, known)
+
+    sliced = [_manager(f"{s}/{shards}") for s in range(shards)]
+    for m in sliced:
+        m.consume_key_message(KEY_MODEL_REF, msg)
+    replayed = [_replay_manager(f"{s}/{shards}", y_ids, Y, x_ids, X,
+                                known) for s in range(shards)]
+    full = _replay_manager("0/1", y_ids, Y, x_ids, X, known)
+
+    for m_s, m_r in zip(sliced, replayed):
+        assert sorted(m_s.model.Y.all_ids()) == \
+            sorted(m_r.model.Y.all_ids())
+        for iid in m_s.model.Y.all_ids():
+            np.testing.assert_array_equal(
+                m_s.model.get_item_vector(iid),
+                m_r.model.get_item_vector(iid), err_msg=iid)
+        # ordinals agree wherever both know the id (the replayed
+        # manager knows every id; the sliced one its locals)
+        for iid, o in m_s.item_ordinals.items():
+            assert m_r.item_ordinals[iid] == o, iid
+        assert m_s._ordinal_next == m_r._ordinal_next
+
+    def ordinal_of(m):
+        return lambda i, m=m: m.item_ordinals.get(i, 1 << 62)
+
+    for u in range(4):
+        uid = f"u{u}"
+        xu = full.model.get_user_vector(uid)
+        exclude = full.model.get_known_items(uid)
+        for how_many in (3, 10, 25):
+            per_sliced = [exact_local_top_n(
+                m.model, ordinal_of(m), how_many, user_vector=xu,
+                exclude=exclude) for m in sliced]
+            per_replayed = [exact_local_top_n(
+                m.model, ordinal_of(m), how_many, user_vector=xu,
+                exclude=exclude) for m in replayed]
+            assert per_sliced == per_replayed, (uid, how_many)
+            merged = merge_top_n(per_sliced, how_many)
+            single = exact_local_top_n(
+                full.model, ordinal_of(full), how_many, user_vector=xu,
+                exclude=exclude)
+            assert merged == single[:how_many], (uid, how_many)
+
+
+def test_post_publish_up_tail_keeps_ordinals_consistent(tmp_path):
+    """New items arriving on the topic tail after a sliced publish get
+    the SAME ordinal on every replica, whichever slices it loaded —
+    the counter advances from the manifest's total item count."""
+    rng = np.random.default_rng(3)
+    y_ids, Y, x_ids, X, known = _catalog(rng, n_items=60)
+    _, _, _, msg = _publish(tmp_path, y_ids, Y, x_ids, X, known)
+    mgrs = [_manager(f"{s}/3") for s in range(3)] + [_manager("0/1")]
+    for m in mgrs:
+        m.consume_key_message(KEY_MODEL_REF, msg)
+    for j in range(5):
+        up = json.dumps(["Y", f"new{j}", _grid_vec(rng)])
+        for m in mgrs:
+            m.consume_key_message(KEY_UP, up)
+    for m in mgrs:
+        for j in range(5):
+            assert m.item_ordinals[f"new{j}"] == len(y_ids) + j
+    # and each lands on exactly one shard of the 3-way ring
+    for j in range(5):
+        holders = [m for m in mgrs[:3]
+                   if f"new{j}" in m.model.Y.all_ids()]
+        assert len(holders) == 1
+        assert shard_of(f"new{j}", 3) == holders[0].shard_index
+
+
+def test_up_update_to_existing_remote_item_keeps_counters_aligned(
+        tmp_path):
+    """Review-hardening regression: a fold-in Y record for an EXISTING
+    item must advance every replica's ordinal counter identically even
+    on replicas that never slice-loaded that item's ordinal (they
+    cannot tell a remote manifest item from a new one) — otherwise the
+    NEXT genuinely new id gets different ordinals per replica and the
+    cluster merge's tie-break diverges by load mode."""
+    rng = np.random.default_rng(14)
+    y_ids, Y, x_ids, X, known = _catalog(rng, n_items=60)
+    _, _, _, msg = _publish(tmp_path, y_ids, Y, x_ids, X, known)
+    shard0, shard1 = _manager("0/2"), _manager("1/2")
+    replayed = _replay_manager("0/1", y_ids, Y, x_ids, X, known)
+    for m in (shard0, shard1):
+        m.consume_key_message(KEY_MODEL_REF, msg)
+    # an existing item owned by shard 0: shard 1 never loaded its
+    # ordinal, the replayed manager knows it
+    existing = next(i for i in y_ids if shard_of(i, 2) == 0)
+    up = json.dumps(["Y", existing, _grid_vec(rng)])
+    for m in (shard0, shard1, replayed):
+        m.consume_key_message(KEY_UP, up)
+    # its ordinal stays STABLE wherever it was known
+    assert shard0.item_ordinals[existing] == \
+        replayed.item_ordinals[existing] == y_ids.index(existing)
+    # ...and the next NEW item's ordinal agrees on EVERY replica
+    up_new = json.dumps(["Y", "brand-new", _grid_vec(rng)])
+    for m in (shard0, shard1, replayed):
+        m.consume_key_message(KEY_UP, up_new)
+    assert shard0.item_ordinals["brand-new"] \
+        == shard1.item_ordinals["brand-new"] \
+        == replayed.item_ordinals["brand-new"]
+
+
+# -- fail-closed fallback (chaos point store-slice-missing) -------------------
+
+@pytest.mark.chaos
+def test_corrupt_slice_fails_closed_to_full_artifact_load(tmp_path):
+    """A checksum-failing slice (chaos: ``store-slice-missing``) falls
+    back to the monolithic Y/X artifacts: the replica still reaches
+    ready with the exact same state, and the fallback is counted."""
+    rng = np.random.default_rng(9)
+    y_ids, Y, x_ids, X, known = _catalog(rng)
+    model_dir, _, _, msg = _publish(tmp_path, y_ids, Y, x_ids, X, known)
+    # the monolithic artifacts the fallback reads (the real publisher
+    # writes them before the slices; known-items are carried by the UP
+    # stream in the pure-reference flow, so the fallback skips them)
+    from oryx_tpu.app.als.update import save_features
+    save_features(model_dir + "/Y", y_ids, Y)
+    save_features(model_dir + "/X", x_ids, X)
+
+    faults.inject("store-slice-missing", mode="error", times=1)
+    mgr = _manager("0/2")
+    mgr.consume_key_message(KEY_MODEL_REF, msg)
+    assert faults.fired("store-slice-missing") == 1
+    assert mgr.slice_load_fallbacks == 1
+    assert mgr.model.get_fraction_loaded() == 1.0  # still READY
+    # state equals a clean slice load's
+    clean = _manager("0/2")
+    clean.consume_key_message(KEY_MODEL_REF, msg)
+    assert sorted(mgr.model.Y.all_ids()) == \
+        sorted(clean.model.Y.all_ids())
+    for iid in mgr.model.Y.all_ids():
+        np.testing.assert_array_equal(mgr.model.get_item_vector(iid),
+                                      clean.model.get_item_vector(iid))
+        assert mgr.item_ordinals[iid] == clean.item_ordinals[iid]
+    assert mgr._ordinal_next == clean._ordinal_next
+    # no fresh manifest Gramian on the fallback path: /shard/yty scans
+    assert mgr.partial_yty() is None
+
+
+def test_truncated_slice_artifact_is_a_checksum_failure(tmp_path):
+    rng = np.random.default_rng(10)
+    y_ids, Y, x_ids, X, known = _catalog(rng, n_items=40)
+    model_dir, _, slim, msg = _publish(tmp_path, y_ids, Y, x_ids, X,
+                                       known)
+    from oryx_tpu.app.als.update import save_features
+    save_features(model_dir + "/Y", y_ids, Y)
+    save_features(model_dir + "/X", x_ids, X)
+    # truncate one slice the 0/2 shard owns (slice 0)
+    victim = os.path.join(model_dir, slim["slices"][0]["path"])
+    with open(victim, "r+b") as f:
+        f.truncate(max(1, os.path.getsize(victim) // 2))
+    mgr = _manager("0/2")
+    mgr.consume_key_message(KEY_MODEL_REF, msg)
+    assert mgr.slice_load_fallbacks == 1
+    assert mgr.model.get_fraction_loaded() == 1.0
+    assert sorted(mgr.model.Y.all_ids()) == \
+        sorted(i for i in y_ids if is_local_item(i, 0, 2))
+
+
+def test_incompatible_ring_falls_back(tmp_path):
+    """A shard count that does not divide the ring cannot map whole
+    slices to shards: the replica falls back to the monolithic
+    artifacts (O(catalog) but correct) and still reaches ready."""
+    rng = np.random.default_rng(11)
+    y_ids, Y, x_ids, X, known = _catalog(rng, n_items=50)
+    model_dir, _, _, msg = _publish(tmp_path, y_ids, Y, x_ids, X,
+                                    known, ring=24)
+    from oryx_tpu.app.als.update import save_features
+    save_features(model_dir + "/Y", y_ids, Y)
+    save_features(model_dir + "/X", x_ids, X)
+    mgr = _manager("2/5")  # 5 does not divide 24
+    mgr.consume_key_message(KEY_MODEL_REF, msg)
+    assert mgr.slice_load_fallbacks == 1 and mgr.slice_loads == 0
+    assert mgr.model.get_fraction_loaded() == 1.0
+    assert sorted(mgr.model.Y.all_ids()) == \
+        sorted(i for i in y_ids if is_local_item(i, 2, 5))
+
+
+def test_owned_slices_contract():
+    assert slices.owned_slices(24, 0, 1) == list(range(24))
+    assert slices.owned_slices(24, 1, 2) == [j for j in range(24)
+                                             if j % 2 == 1]
+    assert slices.owned_slices(24, 2, 3) == [2, 5, 8, 11, 14, 17, 20, 23]
+    assert slices.owned_slices(24, 0, 5) is None
+    # the mapping really is murmur2-consistent: every id in slice j
+    # belongs to shard j % N
+    for iid in (f"x{i}" for i in range(200)):
+        j = shard_of(iid, 24)
+        assert shard_of(iid, 3) == j % 3
+        assert shard_of(iid, 2) == j % 2
+
+
+# -- envelope back-compat -----------------------------------------------------
+
+def test_bare_path_model_ref_still_replays(tmp_path):
+    """Pre-manifest MODEL-REF payloads (a bare path) keep the exact old
+    behavior: PMML loads, no slice load, the UP stream fills the
+    model."""
+    rng = np.random.default_rng(12)
+    y_ids, Y, x_ids, X, known = _catalog(rng, n_items=30)
+    _, pmml_path, _, _ = _publish(tmp_path, y_ids, Y, x_ids, X, known)
+    mgr = _manager("0/1")
+    mgr.consume_key_message(KEY_MODEL_REF, pmml_path)  # bare path
+    assert mgr.slice_loads == 0 and mgr.slice_load_fallbacks == 0
+    assert len(mgr.model.Y) == 0  # awaiting the UP stream, as ever
+    mgr.consume_key_message(KEY_UP, json.dumps(
+        ["Y", y_ids[0], [float(v) for v in Y[0]]]))
+    assert len(mgr.model.Y) == 1
+
+
+def test_parse_model_ref_forms():
+    assert slices.parse_model_ref("/a/b/model.pmml.xml") == \
+        ("/a/b/model.pmml.xml", None, None)
+    path, d, m = slices.parse_model_ref(
+        json.dumps({"path": "/p/m.xml", "dir": "/p",
+                    "manifest": {"ring": 4}}))
+    assert (path, d, m) == ("/p/m.xml", "/p", {"ring": 4})
+    # malformed envelope degrades to bare-path (warn, don't die)
+    path, d, m = slices.parse_model_ref("{not json")
+    assert path == "{not json" and d is None and m is None
+
+
+def test_speed_manager_bulk_loads_every_slice(tmp_path):
+    rng = np.random.default_rng(13)
+    y_ids, Y, x_ids, X, known = _catalog(rng)
+    _, _, _, msg = _publish(tmp_path, y_ids, Y, x_ids, X, known)
+    mgr = ALSSpeedModelManager(from_dict({
+        "oryx.speed.model-manager-class": "unused"}))
+    mgr.consume_key_message(KEY_MODEL_REF, msg)
+    assert mgr.slice_loads == RING and mgr.slice_load_fallbacks == 0
+    assert len(mgr.model.Y) == len(y_ids)
+    assert len(mgr.model.X) == len(x_ids)
+    assert mgr.model.get_fraction_loaded() == 1.0
+    np.testing.assert_array_equal(mgr.model.get_item_vector(y_ids[3]),
+                                  Y[3])
+
+
+# -- the batch publisher end-to-end -------------------------------------------
+
+class _CollectingProducer:
+    def __init__(self):
+        self.sent: list[tuple[str, str]] = []
+
+    def send(self, key, message, headers=None):
+        self.sent.append((key, message))
+
+
+def _als_update_config(tmp_path, max_size=600):
+    return from_dict({
+        "oryx.als.hyperparams.features": FEATURES,
+        "oryx.als.implicit": True,
+        "oryx.als.iterations": 2,
+        "oryx.ml.eval.test-fraction": 0.0,
+        "oryx.ml.eval.candidates": 1,
+        "oryx.update-topic.message.max-size": max_size,
+        "oryx.batch.storage.model-dir": str(tmp_path / "models"),
+    })
+
+
+def _interactions(rng, n=300, users=25, items=40):
+    return [KeyMessage(None, f"u{rng.integers(users)},"
+                             f"i{rng.integers(items)},1,{t}")
+            for t, _ in enumerate(range(n))]
+
+
+def test_als_update_publishes_manifest_envelope_and_skips_up(tmp_path):
+    """run_update with a too-large model publishes the sharded form:
+    one MODEL-REF envelope carrying the manifest, slices + X-known in
+    the store, and NO per-row UP flood; a serving manager loads it to
+    a fully servable model with known-items intact."""
+    from oryx_tpu.app.als.update import ALSUpdate
+
+    rng = np.random.default_rng(5)
+    update = ALSUpdate(_als_update_config(tmp_path))
+    assert update.publish_slices == RING  # reference.conf default
+    producer = _CollectingProducer()
+    update.run_update(0, _interactions(rng), [],
+                      str(tmp_path / "models"), producer)
+    keys = [k for k, _ in producer.sent]
+    assert keys == [KEY_MODEL_REF], keys  # no UP stream at all
+    _, msg = producer.sent[0]
+    path, model_dir, manifest = slices.parse_model_ref(msg)
+    assert manifest is not None and manifest["ring"] == RING
+    assert os.path.exists(os.path.join(model_dir, slices.MANIFEST_FILE))
+
+    mgr = _manager("0/1")
+    mgr.consume_key_message(KEY_MODEL_REF, msg)
+    assert mgr.slice_loads == RING and mgr.slice_load_fallbacks == 0
+    assert mgr.model.get_fraction_loaded() == 1.0
+    assert len(mgr.model.Y) == manifest["items"]
+    # known-items rode the x artifact (the reference carried them on
+    # the X UP stream): a user who interacted has a non-empty set
+    assert any(mgr.model.get_known_items(u)
+               for u in mgr.model.X.all_ids())
+
+
+@pytest.mark.chaos
+def test_slice_publish_failure_falls_back_to_bare_ref_plus_up(tmp_path):
+    """A store failure while writing slices degrades the PUBLISH side:
+    bare-path MODEL-REF + the full UP stream, exactly the pre-manifest
+    contract — a broken slice write never costs the generation (the
+    two stay consistent because publish_additional keys on the
+    manifest's PRESENCE)."""
+    from oryx_tpu.app.als.update import ALSUpdate
+
+    rng = np.random.default_rng(6)
+    data = _interactions(rng)
+    update = ALSUpdate(_als_update_config(tmp_path))
+    producer = _CollectingProducer()
+    update.run_update(0, data, [], str(tmp_path / "models"), producer)
+    path, model_dir, _ = slices.parse_model_ref(producer.sent[0][1])
+    model = pmml_io.read(path)
+    # simulate the NEXT generation's publish hitting a store failure
+    # mid-slice-write: the manifest never lands, prepare returns the
+    # bare path
+    os.remove(os.path.join(model_dir, slices.MANIFEST_FILE))
+    faults.inject("store-write", mode="error", times=1)
+    payload = update.prepare_model_ref_payload(model, path, data, [])
+    assert faults.fired("store-write") == 1
+    assert payload == path  # bare-path degrade
+    assert not os.path.exists(
+        os.path.join(model_dir, slices.MANIFEST_FILE))
+    # ...and publish_additional therefore streams the UP flood again
+    producer2 = _CollectingProducer()
+    update.publish_additional_model_data(model, data, [], model_dir,
+                                         producer2)
+    keys2 = [k for k, _ in producer2.sent]
+    assert keys2 and set(keys2) == {KEY_UP}
+    # a replica consuming the degraded publish converges as ever
+    mgr = _manager("0/1")
+    mgr.consume_key_message(KEY_MODEL_REF, payload)
+    for k, m in producer2.sent:
+        mgr.consume_key_message(k, m)
+    assert mgr.model.get_fraction_loaded() == 1.0
+    assert mgr.slice_loads == 0
+
+
+def test_small_model_still_inlines(tmp_path):
+    """Below max-size nothing changes: inline MODEL + UP stream (the
+    manifest path exists only where load time matters)."""
+    from oryx_tpu.app.als.update import ALSUpdate
+
+    rng = np.random.default_rng(8)
+    update = ALSUpdate(_als_update_config(tmp_path, max_size=16777216))
+    producer = _CollectingProducer()
+    update.run_update(0, _interactions(rng, n=120, users=8, items=10),
+                      [], str(tmp_path / "models"), producer)
+    keys = [k for k, _ in producer.sent]
+    assert keys[0] == KEY_MODEL
+    assert KEY_UP in keys[1:]

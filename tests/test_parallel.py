@@ -72,6 +72,70 @@ def test_distributed_reconstructs_implicit_preferences():
     assert observed.mean() > scores[mask].mean() + 0.2
 
 
+@pytest.mark.parametrize("implicit", [True, False])
+def test_ring_mode_matches_gather_and_single_device(implicit):
+    """The multi-host ring half-sweep (ppermute rotation, Gramian
+    folded into the hops, never a materialized full opposite factor)
+    is the same math as the all-gather step in a different reduction
+    order — both must land on the single-chip trainer within f32
+    reassociation drift."""
+    ratings = _synthetic(implicit=implicit)
+    mesh = build_mesh(8)
+    kwargs = dict(features=6, lam=0.01, alpha=1.0,
+                  implicit=implicit, iterations=4, seed=123)
+    single = train_als(ratings, **kwargs)
+    ring = train_als_distributed(ratings, mesh=mesh, mode="ring",
+                                 **kwargs)
+    gather = train_als_distributed(ratings, mesh=mesh, mode="gather",
+                                   **kwargs)
+    np.testing.assert_allclose(ring.X, single.X, rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(ring.Y, single.Y, rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(ring.X, gather.X, rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(ring.Y, gather.Y, rtol=2e-3, atol=2e-3)
+
+
+def test_ring_mode_with_donated_buffers():
+    """donate_argnums on the factor buffers (in-place HBM update
+    across iterations) must not change results — donation is a memory
+    contract, not a math one."""
+    ratings = _synthetic(nnz=200)
+    mesh = build_mesh(8)
+    kwargs = dict(features=5, lam=0.02, alpha=1.0, implicit=True,
+                  iterations=3, seed=11)
+    plain = train_als_distributed(ratings, mesh=mesh, mode="ring",
+                                  donate=False, **kwargs)
+    donated = train_als_distributed(ratings, mesh=mesh, mode="ring",
+                                    donate=True, **kwargs)
+    np.testing.assert_array_equal(plain.X, donated.X)
+    np.testing.assert_array_equal(plain.Y, donated.Y)
+
+
+def test_ring_blocked_layout_partitions_by_owner_block():
+    """Every interaction lands in exactly one (row, owner-block) slab
+    with a LOCAL index inside the block — the property that keeps the
+    ring schedule's total einsum slots at ~P instead of n_dev x P."""
+    from oryx_tpu.parallel import block_ratings_ring
+
+    ratings = _synthetic(n_users=13, n_items=21, nnz=90)
+    n_dev = 8
+    blocks = block_ratings_ring(ratings, n_dev)
+    assert blocks.u_cols.shape[1] == n_dev
+    assert blocks.i_cols.shape[1] == n_dev
+    # real slot count == nnz on both sides (no duplication, no loss)
+    assert int(blocks.u_mask.sum()) == len(ratings.users)
+    assert int(blocks.i_mask.sum()) == len(ratings.users)
+    # reconstruct the COO pairs from the user-side layout
+    rb = blocks.i_cols.shape[0] and (
+        # item rows padded to a multiple of n_dev, block = pad // n_dev
+        max(n_dev, -(-len(ratings.item_ids) // n_dev) * n_dev) // n_dev)
+    got = set()
+    rows, owners, slots = np.nonzero(blocks.u_mask)
+    for r, b, s in zip(rows, owners, slots):
+        got.add((int(r), int(blocks.u_cols[r, b, s] + b * rb)))
+    want = set(zip(ratings.users.tolist(), ratings.items.tolist()))
+    assert got == want
+
+
 def test_blocked_layout_row_padding():
     ratings = _synthetic(n_users=13, n_items=5, nnz=30)
     blocks = block_ratings(ratings, 8)

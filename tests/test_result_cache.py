@@ -470,3 +470,73 @@ def test_coalesce_only_mode_shares_without_retaining():
     assert entry is not None          # shareable with followers
     assert rc.lookup(_probe(rc)) is None  # never retained
     assert rc.stats()["entries"] == 0
+
+
+# -- negative caching (hot 404s) ----------------------------------------------
+
+def test_negative_store_and_hit_under_the_same_epoch():
+    rc, _, metrics = _build()
+    p = _probe(rc, uid="ghost")
+    assert rc.lookup(p) is None
+    entry = rc.store_negative(p, 404, "ghost")
+    assert entry is not None and entry.status == 404
+    got = rc.lookup(_probe(rc, uid="ghost"))
+    assert got is entry
+    assert rc.negative_hits == 1
+    assert metrics.counters_snapshot().get("cache_negative_hits") == 1
+    # a DIFFERENT missing id is its own key
+    assert rc.lookup(_probe(rc, uid="ghost2")) is None
+
+
+def test_negative_entry_evicted_by_the_creating_up_record():
+    """The whole point: the fold-in that CREATES the user evicts its
+    404 — a freshly folded-in user is never served 'unknown' from the
+    cache."""
+    rc, _, _ = _build()
+    rc.store_negative(_probe(rc, uid="newbie"), 404, "newbie")
+    assert rc.lookup(_probe(rc, uid="newbie")) is not None
+    rc.note_up(json.dumps(["X", "newbie", [0.1, 0.2], ["i1"]]))
+    assert rc.lookup(_probe(rc, uid="newbie")) is None
+    # item-side creation evicts item-tagged 404s too
+    sim = rc.probe("/similarity/{itemIDs:+}", "/similarity/newitem",
+                   {}, {"itemIDs": "newitem"})
+    rc.store_negative(sim, 404, "newitem")
+    rc.note_up(json.dumps(["Y", "newitem", [0.1, 0.2]]))
+    assert rc.lookup(sim) is None
+
+
+def test_negative_store_respects_fencing_and_epoch():
+    rc, reg, _ = _build()
+    p = _probe(rc, uid="gone")
+    # invalidation AFTER the probe fences the store
+    rc.note_up(json.dumps(["X", "gone", [0.1], []]))
+    rc._clock.t += rc.quarantine_sec + 1.0
+    assert rc.store_negative(p, 404, "gone") is None
+    assert rc.store_rejects == 1
+    # epoch moved mid-flight: refused
+    p2 = _probe(rc, uid="gone2")
+    reg.epoch = (2, (6, 6), False)
+    assert rc.store_negative(p2, 404, "gone2") is None
+
+
+def test_negative_caching_gate_and_non_404s():
+    rc, _, _ = _build(**{"oryx.cluster.cache.negative-enabled": False})
+    assert rc.store_negative(_probe(rc), 404, "x") is None
+    rc2, _, _ = _build()
+    # only 404s are negative-cacheable (503s are transient state)
+    assert rc2.store_negative(_probe(rc2), 503, "overloaded") is None
+
+
+def test_negative_entries_flush_with_the_generation():
+    rc, _, _ = _build()
+    rc.store_negative(_probe(rc, uid="ghost"), 404, "ghost")
+    rc.note_generation_publish()
+    assert rc.lookup(_probe(rc, uid="ghost")) is None
+
+
+def test_negative_coalesce_only_shares_without_retaining():
+    rc, _, _ = _build(store=False, coalesce=True)
+    p = _probe(rc, uid="ghost")
+    entry = rc.store_negative(p, 404, "ghost")
+    assert entry is not None and entry.status == 404  # shareable
+    assert rc.lookup(p) is None  # never retained

@@ -114,7 +114,13 @@ def test_mlupdate_publishes_model_ref_through_memory_store():
     keys = [k for k, _ in sent]
     assert KEY_MODEL_REF in keys, keys
     ref = dict(sent)[KEY_MODEL_REF]
-    assert ref.startswith("memory://lake/model/")
+    # since the sharded-distribution PR the MODEL-REF payload is a
+    # manifest-carrying envelope; the path inside keeps the full
+    # memory:// scheme end-to-end
+    from oryx_tpu.app.als.slices import parse_model_ref
+    path, env_dir, manifest = parse_model_ref(ref)
+    assert path.startswith("memory://lake/model/")
+    assert manifest is not None and manifest["ring"] >= 1
     # the .temporary staging dir is cleaned after the atomic publish
     assert store.glob("memory://lake/model", ".temporary/*") == []
     # a consumer resolves the REF through the store alone
@@ -123,9 +129,17 @@ def test_mlupdate_publishes_model_ref_through_memory_store():
     assert pmml_io.get_extension_value(doc, "features") == "4"
     # and the X/Y artifacts load from the same store
     from oryx_tpu.app.als.update import load_features
-    model_dir = ref.rsplit("/", 1)[0]
+    model_dir = path.rsplit("/", 1)[0]
     y_ids, Y = load_features(store.join(model_dir, "Y"))
     assert len(y_ids) == Y.shape[0] > 0 and Y.shape[1] == 4
+    # ...as do the SLICES (a remote-scheme store can serve a sharded
+    # load end-to-end): a 0/1 manager bulk-loads the whole catalog
+    from oryx_tpu.app.als.serving_manager import ALSServingModelManager
+    mgr = ALSServingModelManager(from_dict(
+        {"oryx.serving.model-manager-class": "unused"}))
+    mgr.consume_key_message(KEY_MODEL_REF, ref)
+    assert mgr.slice_load_fallbacks == 0 and mgr.slice_loads > 0
+    assert sorted(mgr.model.Y.all_ids()) == sorted(y_ids)
 
 
 def test_model_ref_resolves_from_other_process_and_cwd(tmp_path):
