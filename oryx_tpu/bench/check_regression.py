@@ -17,7 +17,10 @@ Three artifact families share the machinery, selected by ``--kind``:
   per-replica model-load telemetry (sharded model distribution,
   ISSUE 10) gates as the ``(..., "load")`` pseudo-cell on LOAD SPEED
   (1 / max replica ``model_load_s``), with the same
-  lacking-cell-is-new back-compat.
+  lacking-cell-is-new back-compat.  Since r13 the ``--regions 2``
+  mirror probe (ISSUE 11) gates as the ``(..., "mirror")``
+  pseudo-cell on healed-partition catch-up speed (records/s), same
+  back-compat.
 - ``obs``: ``BENCH_OBS_OVERHEAD_*.json`` — the observability
   hot-path microbench (bench/obs_overhead.py).  Gates on two rules:
   a HARD absolute budget (the unsampled per-request pipeline must
@@ -168,6 +171,23 @@ def _cells(doc: dict) -> dict:
                         1.0 / load["max_replica_load_s"], 4),
                     "model_load_s": load["max_replica_load_s"],
                     "mode": load.get("mode"),
+                }
+            # ISSUE 11 added the two-region mirror probe (`--regions
+            # 2`): it gates as its own (..., "mirror") pseudo-cell
+            # whose headline is healed-partition CATCH-UP SPEED
+            # (records replayed per second after the link returns), so
+            # a mirror-throughput regression cannot hide behind a
+            # healthy qps cell; steady-state staleness rides along for
+            # diagnosis.  Pre-region artifacts simply lack the cell.
+            mir = r.get("mirror")
+            if isinstance(mir, dict) \
+                    and mir.get("catch_up_records_per_s"):
+                out[key + ("mirror",)] = {
+                    "open_loop_sustained_qps":
+                        mir["catch_up_records_per_s"],
+                    "catch_up_s": mir.get("catch_up_s"),
+                    "steady_staleness_ms":
+                        mir.get("steady_staleness_ms"),
                 }
         return out
     return {(r["features"], r["items"], r["lsh"]): r

@@ -874,6 +874,90 @@ def run_load_compare(work_dir: str, items: int, features: int,
     return out
 
 
+def run_mirror_probe(work_dir: str, records: int = 2000,
+                     features: int = 8,
+                     poll_interval_ms: int = 100) -> dict:
+    """The two-region cell (``--regions 2``, ISSUE 11): one real
+    ``python -m oryx_tpu mirror`` process replaying region A's update
+    topic into region B's over durable file:// brokers, measuring
+
+    - **steady-state** ``cross_region_staleness_ms`` while the link is
+      healthy and drained (the mirror's own gauge, sampled);
+    - **healed-partition catch-up**: the link goes down (mirror
+      killed), ``records`` ts-stamped UP records accumulate on the
+      source, the link heals (fresh mirror, same durable checkpoint —
+      the crash-resume path), and the probe clocks source-head to
+      drained.  Catch-up speed (records/s) is the gated headline: a
+      region must not fall further behind while it is catching up.
+    """
+    a_dir = os.path.join(work_dir, "mirror-region-a")
+    b_dir = os.path.join(work_dir, "mirror-region-b")
+    ckpt = os.path.join(work_dir, "mirror-ckpt")
+    os.makedirs(a_dir, exist_ok=True)
+    os.makedirs(b_dir, exist_ok=True)
+
+    def _append_ups(n: int, start: int) -> None:
+        now_ms = int(time.time() * 1000)
+        vec = [round(0.01 * j, 4) for j in range(features)]
+        with open(os.path.join(a_dir, "GwUp.topic.jsonl"), "a",
+                  encoding="utf-8") as f:
+            for j in range(start, start + n):
+                f.write(json.dumps(
+                    ["UP", json.dumps(["X", f"mu{j}", vec, []]),
+                     {"ts": str(now_ms)}]) + "\n")
+
+    obs_port = _free_port()
+    conf = os.path.join(work_dir, "mirror.conf")
+    _write_conf(conf, b_dir, _free_port(), {
+        "oryx.cluster.region.name": "bench-b",
+        "oryx.cluster.region.mirror.source-broker": f"file://{a_dir}",
+        "oryx.cluster.region.mirror.source-region": "bench-a",
+        "oryx.cluster.region.mirror.checkpoint-dir": ckpt,
+        "oryx.cluster.region.mirror.poll-interval-ms": poll_interval_ms,
+        "oryx.obs.metrics-port": obs_port,
+        "oryx.resilience.supervisor.enabled": False,
+    })
+    log_path = os.path.join(work_dir, "mirror-probe.log")
+
+    def _gauges() -> dict:
+        return _get_json(obs_port, "/metrics").get("freshness", {})
+
+    _append_ups(records // 4, 0)  # a warm link carries live traffic
+    proc = _spawn(["mirror"], conf, None, log_path)
+    try:
+        _await(lambda: _gauges().get("mirror_lag_records") == 0,
+               "mirror steady drain", timeout=240.0)
+        time.sleep(3 * poll_interval_ms / 1000.0)
+        steady = [_gauges().get("cross_region_staleness_ms")
+                  for _ in range(5)]
+        steady = [s for s in steady if s is not None]
+    finally:
+        proc.kill()  # the partition: the link is gone, not drained
+        proc.wait(timeout=15)
+    _append_ups(records, records // 4)  # backlog behind the partition
+    t0 = time.time()
+    proc = _spawn(["mirror"], conf, None, log_path)
+    try:
+        _await(lambda: _gauges().get("mirror_lag_records") == 0,
+               "mirror catch-up", timeout=600.0)
+        catch_up_s = time.time() - t0
+        counters = _get_json(obs_port, "/metrics")["counters"]
+    finally:
+        proc.kill()
+        proc.wait(timeout=15)
+    return {
+        "records": records,
+        "steady_staleness_ms": (round(float(np.median(steady)), 1)
+                                if steady else None),
+        "catch_up_s": round(catch_up_s, 2),
+        # includes the fresh process's spawn cost — honest: that IS
+        # the heal-to-drained wall clock a failover runbook sees
+        "catch_up_records_per_s": round(records / catch_up_s, 1),
+        "replayed": counters.get("mirror_records_replayed"),
+        "dedup_skips": counters.get("mirror_dedup_skips", 0),
+    }
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--replicas", default="1,2,4",
@@ -974,6 +1058,17 @@ def main(argv: list[str] | None = None) -> int:
                          "check_regression as the (..., 'load') "
                          "pseudo-cell.  0 = the pre-r12 full-stream "
                          "replay publish")
+    ap.add_argument("--regions", type=int, default=1,
+                    help="2 = run the two-region mirror probe before "
+                         "the qps cells: steady-state "
+                         "cross_region_staleness_ms and "
+                         "healed-partition catch-up over a real "
+                         "mirror process + file:// brokers, gated by "
+                         "check_regression as the (..., 'mirror') "
+                         "pseudo-cell on catch-up records/s")
+    ap.add_argument("--mirror-records", type=int, default=2000,
+                    help="backlog size the mirror probe's healed "
+                         "partition must catch up through")
     ap.add_argument("--load-compare", type=int, default=0,
                     help="before the qps cells, publish the catalog "
                          "BOTH ways and boot this many shards against "
@@ -998,6 +1093,12 @@ def main(argv: list[str] | None = None) -> int:
         # one shared broker/model stream: every cell's replicas replay
         # the identical totally-ordered topic (cells run sequentially;
         # dead cells' heartbeats age out past the TTL)
+        mirror_probe = None
+        if args.regions >= 2:
+            print("== two-region mirror probe ==", file=sys.stderr)
+            mirror_probe = run_mirror_probe(
+                work_dir, records=args.mirror_records)
+            print(json.dumps(mirror_probe), file=sys.stderr)
         load_compare = None
         if args.load_compare > 0:
             print("== load-compare probe (replay vs sliced) ==",
@@ -1057,6 +1158,10 @@ def main(argv: list[str] | None = None) -> int:
                 coalesce_burst=args.coalesce_burst,
                 sharded_publish=args.sharded_publish)
             row["publish_s"] = publish_s
+            if mirror_probe is not None and not rows:
+                # the probe rides the FIRST row as its (..., "mirror")
+                # pseudo-cell — one measurement per round, one gate
+                row["mirror"] = mirror_probe
             rows.append(row)
             print(json.dumps({k: v for k, v in rows[-1].items()
                               if k != "ladder"}), file=sys.stderr)
@@ -1073,6 +1178,8 @@ def main(argv: list[str] | None = None) -> int:
         "cache_armed": args.cache,
         "sharded_publish": args.sharded_publish or None,
         "load_compare": load_compare,
+        "regions": args.regions,
+        "mirror_probe": mirror_probe,
         "zipf_a": args.zipf or None,
         "tracing_sample": args.tracing_sample,
         "emulated_device_ms_per_mrow": args.device_ms_per_mrow,

@@ -99,20 +99,30 @@ class Heartbeat:
     ready: bool           # fraction loaded past the serving gate
     fraction: float = 0.0
     ts: float = 0.0       # sender wall clock (diagnostic only)
+    # region identity (oryx.cluster.region.name; None = unset): a
+    # multi-region deployment's defense in depth — the mirror already
+    # drops HB records at the link (cluster/mirror.py), but a
+    # misconfigured shared topic must still never route a router at
+    # replicas it cannot reach across the region boundary
+    region: str | None = None
 
     def to_json(self) -> str:
-        return json.dumps(self.__dict__, separators=(",", ":"))
+        d = {k: v for k, v in self.__dict__.items()
+             if not (k == "region" and v is None)}
+        return json.dumps(d, separators=(",", ":"))
 
     @classmethod
     def from_json(cls, s: str) -> "Heartbeat | None":
         try:
             d = json.loads(s)
+            region = d.get("region")
             return cls(replica=str(d["replica"]), shard=int(d["shard"]),
                        of=int(d["of"]), url=str(d["url"]),
                        generation=int(d["generation"]),
                        ready=bool(d["ready"]),
                        fraction=float(d.get("fraction", 0.0)),
-                       ts=float(d.get("ts", 0.0)))
+                       ts=float(d.get("ts", 0.0)),
+                       region=None if region is None else str(region))
         except (ValueError, TypeError, KeyError):
             return None  # malformed control message: ignore, don't die
 
@@ -129,9 +139,17 @@ class MembershipRegistry:
     answers as ``m/N`` against the true topology.
     """
 
-    def __init__(self, ttl_sec: float, clock=time.monotonic):
+    def __init__(self, ttl_sec: float, clock=time.monotonic,
+                 region: str | None = None):
         self.ttl_sec = ttl_sec
         self._clock = clock
+        # this router's region (oryx.cluster.region.name).  With a
+        # region set, a heartbeat stamped with a DIFFERENT region is
+        # rejected like a stale topology: the replica is in another
+        # region's fleet and routing to it would cross the region
+        # boundary the mirror exists to avoid.  Unstamped heartbeats
+        # (single-region deployments, older replicas) always merge.
+        self.region = region
         self._lock = threading.Lock()
         # replica id -> (Heartbeat, last_seen_monotonic)
         self._replicas: dict[str, tuple[Heartbeat, float]] = {}
@@ -196,6 +214,14 @@ class MembershipRegistry:
             now = self._clock()
             if hb.of < 1 or not 0 <= hb.shard < hb.of:
                 # structurally invalid shard coordinates: never routable
+                self.stale_topology_heartbeats += 1
+                self._replicas.pop(hb.replica, None)
+                return False
+            if (self.region is not None and hb.region is not None
+                    and hb.region != self.region):
+                # a foreign region's replica on this topic (mirror
+                # misconfiguration, shared broker): countable evidence,
+                # never merged — its URL is across the region boundary
                 self.stale_topology_heartbeats += 1
                 self._replicas.pop(hb.replica, None)
                 return False
@@ -489,7 +515,8 @@ class HeartbeatPublisher:
     def __init__(self, producer, shard: int, of: int, url: str,
                  manager, min_fraction: float,
                  interval_sec: float = 0.5,
-                 replica_id: str | None = None):
+                 replica_id: str | None = None,
+                 region: str | None = None):
         self._producer = producer
         self.shard = shard
         self.of = of
@@ -498,6 +525,7 @@ class HeartbeatPublisher:
         self._min_fraction = min_fraction
         self.interval_sec = interval_sec
         self.replica_id = replica_id or uuid.uuid4().hex[:12]
+        self.region = region
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.published = 0
@@ -510,7 +538,7 @@ class HeartbeatPublisher:
             url=self.url,
             generation=int(getattr(self._manager, "generation", 0)),
             ready=model is not None and fraction >= self._min_fraction,
-            fraction=fraction, ts=time.time())
+            fraction=fraction, ts=time.time(), region=self.region)
 
     def publish_once(self) -> bool:
         if faults.fire("replica-heartbeat-drop") == "drop":

@@ -46,9 +46,9 @@ from ..lambda_rt.metrics import MetricsRegistry
 from ..obs import (engine_from_config, events_from_config,
                    merge_snapshots, render_openmetrics_blocks,
                    render_prometheus_blocks, tracer_from_config)
-from ..obs.server import (OPENMETRICS_CTYPE, admin_profile, admin_slo,
-                          admin_tail, admin_traces,
-                          own_prometheus_snapshot)
+from ..obs.server import (OPENMETRICS_CTYPE, admin_profile,
+                          admin_region, admin_slo, admin_tail,
+                          admin_traces, own_prometheus_snapshot)
 from ..ops import als_fold_in
 from ..ops.solver import SingularMatrixSolverException, get_solver
 from ..resilience import faults
@@ -770,6 +770,9 @@ ROUTES = [
     # mutating: captures device state to disk — read-only mode and
     # DIGEST auth (when configured) both gate it
     Route("GET", "/admin/profile", admin_profile, mutates=True),
+    # region identity: which active-active region answered — the
+    # failover runbook's first probe (docs/SCALING.md "Multi-region")
+    Route("GET", "/admin/region", admin_region),
     # elastic-topology admin: reshard status + target declaration
     Route("GET", "/admin/topology", _topology_get),
     Route("POST", "/admin/topology", _topology_post, mutates=True),
@@ -821,7 +824,12 @@ class RouterLayer:
                              "replica membership")
         faults.configure_from_config(config)
         ttl = config.get_int("oryx.cluster.heartbeat-ttl-ms") / 1000.0
-        self.membership = MembershipRegistry(ttl)
+        # region-pinned membership (multi-region serving): a foreign
+        # region's heartbeats on this topic — a mirror misconfiguration
+        # — are rejected, never routed (docs/SCALING.md "Multi-region")
+        self.region = config.get_optional_string(
+            "oryx.cluster.region.name")
+        self.membership = MembershipRegistry(ttl, region=self.region)
         # sampled distributed tracing (obs/trace.py; None = disabled):
         # the request span opens at the HTTP dispatcher, each shard
         # query runs under a router.shard_call span whose context rides
@@ -890,6 +898,10 @@ class RouterLayer:
                 "events": self.events,
                 "yty_cache": {},
                 "yty_lock": threading.Lock(),
+                # /admin/region enrichment: the router's region answers
+                # with its routed topology + epoch so a failover
+                # runbook reads identity AND health in one probe
+                "region_info": self._region_info,
             },
             read_only=self.read_only,
             user_name=config.get_optional_string(f"{api}.user-name"),
@@ -898,6 +910,20 @@ class RouterLayer:
             request_deadline_ms=config.get_int(
                 "oryx.resilience.request-deadline-ms"),
         )
+
+    def _region_info(self) -> dict:
+        """The router's /admin/region block: identity + the local
+        fleet's routed topology and cache epoch, so re-pointed clients
+        can verify both WHERE they landed and that the region can
+        serve (the failover runbook's one probe)."""
+        of, gens, mixed = self.membership.generation_topology()
+        return {
+            "role": "router",
+            "merged_of": of,
+            "covered_shards": self.membership.covered_shards(),
+            "generation_epoch": list(gens),
+            "epoch_mixed": mixed,
+        }
 
     # -- lifecycle -----------------------------------------------------------
 

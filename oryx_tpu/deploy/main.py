@@ -104,6 +104,27 @@ def _cmd_router(args) -> int:
     return 0
 
 
+def _cmd_mirror(args) -> int:
+    """The cross-region update-topic mirror (cluster/mirror.py): tails
+    a source region's update topic and replays it into this region's
+    topic with exactly-once-effective dedup, loop prevention, and
+    measured staleness gauges (docs/SCALING.md "Multi-region")."""
+    from ..cluster.mirror import MirrorLayer
+    config = _load_config(args.conf)
+    if args.source_broker or args.source_region:
+        from ..common.config import from_dict
+        overlay = {}
+        if args.source_broker:
+            overlay["oryx.cluster.region.mirror.source-broker"] = \
+                args.source_broker
+        if args.source_region:
+            overlay["oryx.cluster.region.mirror.source-region"] = \
+                args.source_region
+        config = from_dict(overlay, config)
+    _run_layer(lambda: MirrorLayer(config), "mirror", config)
+    return 0
+
+
 def _cmd_autoscale(args) -> int:
     """The gauge-driven supervisor (cluster/autoscaler.py): polls the
     router's merged p99 buckets / measured queue wait / replica update
@@ -249,6 +270,11 @@ def main(argv: list[str] | None = None) -> int:
              "run the gauge-driven supervisor: scale replica groups "
              "from the router's measured p99/queue-wait/lag signals "
              "and SLO burn rate"),
+            ("mirror", _cmd_mirror,
+             "run the cross-region update-topic mirror: replay a "
+             "source region's updates into this region's topic with "
+             "exactly-once-effective dedup and measured staleness "
+             "(oryx.cluster.region.*)"),
             ("kafka-setup", _cmd_kafka_setup, "create/check topics"),
             ("kafka-tail", _cmd_kafka_tail, "print topic traffic"),
             ("kafka-input", _cmd_kafka_input, "send lines to input topic"),
@@ -271,6 +297,16 @@ def main(argv: list[str] | None = None) -> int:
             p.add_argument("--router-url", default=None,
                            help="router base URL to poll (overrides "
                                 "oryx.cluster.autoscale.router-url)")
+        if name == "mirror":
+            p.add_argument("--source-broker", default=None,
+                           help="remote region's update-topic broker "
+                                "(overrides oryx.cluster.region."
+                                "mirror.source-broker)")
+            p.add_argument("--source-region", default=None,
+                           help="name recorded as origin-region for "
+                                "records born at the source (overrides "
+                                "oryx.cluster.region.mirror."
+                                "source-region)")
         if name == "kafka-tail":
             p.add_argument("--once", action="store_true",
                            help="drain current contents and exit")

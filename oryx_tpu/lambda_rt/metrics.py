@@ -142,6 +142,23 @@ class MetricsRegistry:
         with self._lock:
             return dict(sorted(self._counters.items()))
 
+    def gauge_value(self, gauge: str) -> float | None:
+        """Evaluate ONE gauge by name (set value or computed fn),
+        best-effort.  The SLO engine's kind=gauge objectives read their
+        watched gauge through this instead of ``gauges_snapshot`` so
+        evaluation cannot recurse through the engine's own exported
+        ``slo_*`` gauges."""
+        with self._lock:
+            if gauge in self._gauges:
+                return self._gauges[gauge]
+            fn = self._gauge_fns.get(gauge)
+        if fn is None:
+            return None
+        try:
+            return fn()
+        except Exception:  # noqa: BLE001 — gauges are best-effort
+            return None
+
     def gauges_snapshot(self) -> dict:
         with self._lock:
             out = dict(self._gauges)

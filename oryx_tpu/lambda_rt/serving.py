@@ -239,7 +239,9 @@ class ServingLayer:
                 interval_sec=self.config.get_int(
                     f"{c}.heartbeat-interval-ms") / 1000.0,
                 replica_id=self.config.get_optional_string(
-                    f"{c}.replica-id"))
+                    f"{c}.replica-id"),
+                region=self.config.get_optional_string(
+                    f"{c}.region.name"))
             self.heartbeat.start()
 
     @staticmethod

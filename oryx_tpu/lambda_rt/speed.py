@@ -181,8 +181,14 @@ class SpeedLayer:
                 new_data = broker.read_ranges(self.input_topic, pos, ends)
                 updates = self.model_manager.build_updates(new_data)
                 n_updates = 0
+                # UP deltas carry a `ts` publish-stamp header so a
+                # cross-region mirror (cluster/mirror.py) can measure
+                # exact record age at replay — the PR 5 header
+                # machinery, consumers treat it as absent-by-default
+                up_headers = {"ts": str(int(time.time() * 1000))}
                 for update in updates:
-                    self._producer.send(KEY_UP, update)
+                    self._producer.send(KEY_UP, update,
+                                        headers=up_headers)
                     n_updates += 1
                 # commit BEFORE advancing the in-memory position: a
                 # failed commit must leave pos behind so the batch
@@ -207,11 +213,12 @@ class SpeedLayer:
         t_batch = time.monotonic()
         new_data = broker.read_ranges(self.input_topic, pos, ends)
         n_updates = 0
+        up_headers = {"ts": str(int(time.time() * 1000))}
         for update in self.model_manager.build_updates(new_data):
             # chaos seam: UP delta publish failure — offsets must not
             # advance past an unpublished delta
             faults.fire("speed-publish")
-            self._producer.send(KEY_UP, update)
+            self._producer.send(KEY_UP, update, headers=up_headers)
             n_updates += 1
         broker.set_offsets(self._group, self.input_topic, ends)
         self._note_micro_batch(new_data, n_updates, t_batch)

@@ -25,6 +25,7 @@ import logging
 from ..api.serving import OryxServingException
 from ..lambda_rt.http import (HttpApp, Request, Route, TextResponse,
                               make_server)
+from ..resilience.policy import resilience_snapshot
 from . import anatomy
 from . import profile as profile_mod
 from .prom import render_openmetrics, render_prometheus
@@ -32,7 +33,7 @@ from .prom import render_openmetrics, render_prometheus
 _log = logging.getLogger(__name__)
 
 __all__ = ["admin_traces", "admin_tail", "admin_slo", "admin_profile",
-           "registry_metrics", "own_prometheus_snapshot",
+           "admin_region", "registry_metrics", "own_prometheus_snapshot",
            "prometheus_response", "gather_traces", "ObsServer",
            "OPENMETRICS_CTYPE"]
 
@@ -80,7 +81,13 @@ def registry_metrics(req: Request):
     if prom is not None:
         return prom
     out = {"routes": registry.snapshot(),
-           "counters": registry.counters_snapshot()}
+           "counters": registry.counters_snapshot(),
+           # named retry / circuit-breaker stats (resilience/policy.py):
+           # the headless tiers (speed, batch, mirror) run producers
+           # behind breakers too, and an operator must be able to see
+           # breaker state wherever /metrics is served — the serving
+           # tier and router already expose the same block
+           "resilience": resilience_snapshot()}
     gauges = registry.gauges_snapshot()
     if gauges:
         out["freshness"] = gauges
@@ -180,6 +187,23 @@ def admin_slo(req: Request):
     return engine.status()
 
 
+def admin_region(req: Request):
+    """Region identity (multi-region serving, docs/SCALING.md): which
+    region this process serves, from ``oryx.cluster.region.name``.
+    The failover runbook's first question — "which region am I talking
+    to?" — answered by every tier; processes with richer region state
+    (the router's membership view, the mirror's link status) merge it
+    in via the ``region_info`` context hook."""
+    config = req.context.get("config")
+    name = config.get_optional_string("oryx.cluster.region.name") \
+        if config is not None else None
+    out = {"region": name}
+    info = req.context.get("region_info")
+    if callable(info):
+        out.update(info())
+    return out
+
+
 def admin_profile(req: Request):
     """On-demand device profile capture (obs/profile.py)."""
     config = req.context.get("config")
@@ -200,6 +224,7 @@ OBS_ROUTES = [
     Route("GET", "/admin/traces", admin_traces),
     Route("GET", "/admin/tail", admin_tail),
     Route("GET", "/admin/slo", admin_slo),
+    Route("GET", "/admin/region", admin_region),
     # mutating: captures device state to disk — read-only mode and
     # DIGEST auth (when configured) both gate it
     Route("GET", "/admin/profile", admin_profile, mutates=True),
@@ -210,7 +235,8 @@ class ObsServer:
     """Minimal metrics/traces HTTP server for the headless tiers."""
 
     def __init__(self, config, registry, tracer,
-                 port: int | None = None):
+                 port: int | None = None,
+                 extra_context: dict | None = None):
         self.port = port if port is not None \
             else config.get_optional_int("oryx.obs.metrics-port")
         self._server = None
@@ -223,6 +249,7 @@ class ObsServer:
             "metrics": registry,
             "tracer": tracer,
             "config": config,
+            **(extra_context or {}),
         }, read_only=config.get_bool(f"{api}.read-only"),
            user_name=config.get_optional_string(f"{api}.user-name"),
            password=config.get_optional_string(f"{api}.password"))

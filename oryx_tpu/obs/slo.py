@@ -68,15 +68,27 @@ def is_data_plane(route: str) -> bool:
 
 
 class SloObjective:
-    """One declared objective under ``oryx.obs.slo.objectives.<name>``."""
+    """One declared objective under ``oryx.obs.slo.objectives.<name>``.
+
+    Kinds: ``availability`` (good = non-5xx) and ``latency`` (good =
+    within a fixed bucket bound) count real requests; ``gauge`` counts
+    evaluation *ticks* — each tick is good when the named registry
+    gauge sits at or below ``max-value`` — turning a measured bound
+    (e.g. the mirror's ``cross_region_staleness_ms``) into the same
+    burn-rate alert discipline: a region allowed to be stale 1% of the
+    time pages when staleness burns that budget 14.4x too fast.  Tick
+    counters are cumulative and monotone, so the ring/baseline window
+    math is unchanged."""
 
     __slots__ = ("name", "kind", "target", "threshold_ms",
-                 "route_prefix")
+                 "route_prefix", "gauge", "max_value",
+                 "_ticks_good", "_ticks_total")
 
     def __init__(self, name: str, kind: str = "availability",
                  target: float = 0.999, threshold_ms: float = 0.0,
-                 route_prefix: str | None = None):
-        if kind not in ("availability", "latency"):
+                 route_prefix: str | None = None,
+                 gauge: str | None = None, max_value: float = 0.0):
+        if kind not in ("availability", "latency", "gauge"):
             raise ValueError(f"SLO {name}: unknown kind {kind!r}")
         if not 0.0 < target < 1.0:
             raise ValueError(f"SLO {name}: target must be in (0, 1)")
@@ -88,11 +100,32 @@ class SloObjective:
                     f"{LATENCY_BUCKETS_MS} — the good-count is a bucket "
                     f"counter, so the threshold must sit on a bucket "
                     f"edge to stay exact")
+        if kind == "gauge":
+            if not gauge:
+                raise ValueError(
+                    f"SLO {name}: kind=gauge requires the `gauge` name")
+            if gauge.startswith("slo_"):
+                # the engine's own exports call evaluate() from their
+                # gauge fns: watching one would deadlock evaluation on
+                # its (non-reentrant) lock
+                raise ValueError(
+                    f"SLO {name}: kind=gauge cannot watch the "
+                    f"engine's own {gauge!r} export")
+            if not max_value > 0.0:
+                # the implicit 0.0 default would count every positive
+                # reading bad — a page that never clears
+                raise ValueError(
+                    f"SLO {name}: kind=gauge requires a positive "
+                    f"`max-value` (the measured bound)")
         self.name = name
         self.kind = kind
         self.target = float(target)
         self.threshold_ms = float(threshold_ms)
         self.route_prefix = route_prefix
+        self.gauge = gauge
+        self.max_value = float(max_value)
+        self._ticks_good = 0
+        self._ticks_total = 0
 
     @property
     def budget(self) -> float:
@@ -102,6 +135,17 @@ class SloObjective:
         if self.route_prefix is not None:
             return route.split(" ", 1)[-1].startswith(self.route_prefix)
         return is_data_plane(route)
+
+    def gauge_tick(self, value: float | None) -> tuple[int, int]:
+        """Advance and return the cumulative tick counters for a
+        ``gauge`` objective: one (good-if-within-bound, total) sample
+        per evaluation.  A None reading casts no vote — a mirror that
+        has not polled yet must not page before it can measure."""
+        if value is not None:
+            self._ticks_total += 1
+            if float(value) <= self.max_value:
+                self._ticks_good += 1
+        return self._ticks_good, self._ticks_total
 
     def counts(self, routes: dict) -> tuple[int, int]:
         """Cumulative ``(good, total)`` over the matching routes of one
@@ -150,6 +194,9 @@ class SloEngine:
             "objectives": {
                 o.name: {"kind": o.kind, "target": o.target,
                          "threshold_ms": o.threshold_ms or None,
+                         "gauge": o.gauge,
+                         "max_value": o.max_value if o.kind == "gauge"
+                         else None,
                          "state": "ok", "since": None,
                          "transitions": 0, "windows": {}}
                 for o in self.objectives},
@@ -199,8 +246,17 @@ class SloEngine:
                 faults.fire("obs-slo-eval-error")
                 routes = self._registry.prometheus_snapshot(
                     gauges=False)["routes"]
-                counts = {o.name: o.counts(routes)
-                          for o in self.objectives}
+                # gauge objectives sample their watched gauge by name
+                # (never a full gauges_snapshot — the engine's own
+                # slo_* exports would recurse straight back here;
+                # SloObjective.__init__ rejects watching them)
+                counts = {}
+                for o in self.objectives:
+                    if o.kind == "gauge":
+                        counts[o.name] = o.gauge_tick(
+                            self._registry.gauge_value(o.gauge))
+                    else:
+                        counts[o.name] = o.counts(routes)
                 self._ring.append((now, counts))
                 while self._ring and now - self._ring[0][0] > self._horizon:
                     self._ring.popleft()
@@ -290,7 +346,9 @@ def engine_from_config(config, registry) -> SloEngine | None:
             kind=str(spec.get("kind", "availability")),
             target=float(spec.get("target", 0.999)),
             threshold_ms=float(spec.get("threshold-ms", 0.0) or 0.0),
-            route_prefix=spec.get("route-prefix")))
+            route_prefix=spec.get("route-prefix"),
+            gauge=spec.get("gauge"),
+            max_value=float(spec.get("max-value", 0.0) or 0.0)))
     return SloEngine(
         objectives, registry,
         fast_burn=config.get_double(f"{base}.fast-burn"),

@@ -8,7 +8,9 @@ under the failure it guards against (tests/test_resilience_it.py).
 
 Every named :class:`Retry` and :class:`CircuitBreaker` self-registers
 in a process-wide table; :func:`resilience_snapshot` renders their
-counters for the serving ``/metrics`` surface.
+counters for every tier's ``/metrics`` surface — the serving tier and
+router on their main port, the headless tiers (speed, batch, mirror)
+via the side-door ObsServer (obs/server.py).
 """
 
 from __future__ import annotations
@@ -33,7 +35,10 @@ __all__ = [
 
 def run_with_resubscribe(fn: Callable[[], Any], stop: "threading.Event",
                          what: str, backoff: "Backoff | None" = None,
-                         log: logging.Logger | None = None) -> None:
+                         log: logging.Logger | None = None,
+                         healthy_reset_sec: float = 300.0,
+                         clock: Callable[[], float] = time.monotonic
+                         ) -> None:
     """Run a blocking subscription (``fn`` returns only on clean end)
     until it completes or ``stop`` is set, restarting it with backoff
     on failure.
@@ -41,15 +46,29 @@ def run_with_resubscribe(fn: Callable[[], Any], stop: "threading.Event",
     The shared shape of the speed/serving update-topic consumers: a
     broker failure mid-tail must not freeze model state for the life of
     the process, and since their state build is a full replay from
-    offset 0, recovery IS the cold-start path — the same proven code."""
+    offset 0, recovery IS the cold-start path — the same proven code.
+
+    Two bounds matter for failover latency (a mirror or router being
+    re-pointed must neither wait out a stale backoff nor a full one):
+
+    - a subscription that stayed up ``healthy_reset_sec`` before
+      failing resets the attempt counter, so the NEXT resubscribe
+      waits the initial backoff, not the lifetime-accumulated maximum
+      (the Supervisor's healthy-reset contract, applied here);
+    - the inter-attempt sleep is ``stop.wait`` — setting ``stop``
+      interrupts it immediately, so shutdown latency is bounded by the
+      running ``fn``, never by a backoff sleep."""
     backoff = backoff or Backoff(initial=0.1, maximum=5.0)
     log = log or _log
     attempt = 0
     while not stop.is_set():
+        started = clock()
         try:
             fn()
             return  # clean end: stop was requested
         except Exception:  # noqa: BLE001 — resubscribe, don't die
+            if clock() - started >= healthy_reset_sec:
+                attempt = 0
             attempt += 1
             log.exception("%s failed; resubscribing (attempt %d)",
                           what, attempt)

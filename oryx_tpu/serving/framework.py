@@ -16,8 +16,8 @@ from typing import Any
 from ..api.serving import OryxServingException
 from ..lambda_rt.http import (HtmlResponse, Request, Route, TextResponse,
                               render_error_page)
-from ..obs.server import (admin_profile, admin_slo, admin_tail,
-                          admin_traces, prometheus_response)
+from ..obs.server import (admin_profile, admin_region, admin_slo,
+                          admin_tail, admin_traces, prometheus_response)
 from ..resilience.policy import CircuitOpenError, resilience_snapshot
 
 __all__ = ["ROUTES", "get_serving_model", "send_input"]
@@ -168,6 +168,8 @@ ROUTES = [
     # both 404 until their config gates open
     Route("GET", "/admin/tail", admin_tail),
     Route("GET", "/admin/slo", admin_slo),
+    # region identity (multi-region serving, docs/SCALING.md)
+    Route("GET", "/admin/region", admin_region),
     # mutating: captures device state to disk — read-only mode and
     # DIGEST auth (when configured) both gate it
     Route("GET", "/admin/profile", admin_profile, mutates=True),

@@ -380,6 +380,59 @@ def test_check_regression_gateway_load_cell_back_compat(tmp_path,
     assert not report["regressions"]
 
 
+def test_check_regression_gateway_mirror_cell_gates_on_catchup_speed(
+        tmp_path, capsys):
+    """The r13 two-region mirror probe (ISSUE 11) gates as its own
+    pseudo-cell on healed-partition catch-up records/s: a mirror
+    replay-throughput regression fails the gate even when the qps cell
+    held, and steady staleness rides along for diagnosis."""
+    prev = _gateway_doc([(50, 65536, 1, 100.0)])
+    prev["rows"][0]["mirror"] = {"catch_up_records_per_s": 900.0,
+                                 "catch_up_s": 2.2,
+                                 "steady_staleness_ms": 90.0}
+    cur = _gateway_doc([(50, 65536, 1, 101.0)])
+    cur["rows"][0]["mirror"] = {"catch_up_records_per_s": 500.0,
+                                "catch_up_s": 4.0,
+                                "steady_staleness_ms": 95.0}
+    rc = cr.main(["--kind", "gateway",
+                  "--previous", _write(tmp_path,
+                                       "BENCH_GATEWAY_r12.json", prev),
+                  "--current", _write(tmp_path,
+                                      "BENCH_GATEWAY_r13.json", cur)])
+    assert rc == 1
+    report = json.loads(capsys.readouterr().out)
+    assert [c["cell"] for c in report["regressions"]] == \
+        ["50f/0.065536M/1rep/mirror"]
+    # a faster catch-up gates green
+    cur["rows"][0]["mirror"]["catch_up_records_per_s"] = 1800.0
+    rc = cr.main(["--kind", "gateway",
+                  "--previous", _write(tmp_path,
+                                       "BENCH_GATEWAY_r12.json", prev),
+                  "--current", _write(tmp_path,
+                                      "BENCH_GATEWAY_r13.json", cur)])
+    assert rc == 0
+
+
+def test_check_regression_gateway_mirror_cell_back_compat(tmp_path,
+                                                          capsys):
+    """Pre-region artifacts carry no mirror block: the pseudo-cell is
+    reported new, never gated against them."""
+    prev = _gateway_doc([(50, 65536, 1, 100.0)])           # r12 shape
+    cur = _gateway_doc([(50, 65536, 1, 99.0)])
+    cur["rows"][0]["mirror"] = {"catch_up_records_per_s": 900.0,
+                                "catch_up_s": 2.2,
+                                "steady_staleness_ms": 90.0}
+    rc = cr.main(["--kind", "gateway",
+                  "--previous", _write(tmp_path,
+                                       "BENCH_GATEWAY_r12.json", prev),
+                  "--current", _write(tmp_path,
+                                      "BENCH_GATEWAY_r13.json", cur)])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["new_cells"] == ["(50, 65536, 1, 1, 'mirror')"]
+    assert not report["regressions"]
+
+
 def test_check_regression_gateway_discovers_rounds_and_skips_cross_backend(
         tmp_path, capsys):
     _write(tmp_path, "BENCH_GATEWAY_r07.json",

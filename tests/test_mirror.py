@@ -1,0 +1,520 @@
+"""Cross-region mirror unit tests (cluster/mirror.py, ISSUE 11): the
+exactly-once-effective replay contract — origin headers, loop
+prevention, the checkpoint + dedup fence across a crash — plus the
+measured-staleness gauges, the kind="gauge" SLO objective, the
+region-pinned membership rejection, and the headless /metrics
+resilience block.  All in-process over memory:// brokers; the
+real-process two-region chaos IT is tests/test_region_it.py."""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+import uuid
+
+import pytest
+
+from oryx_tpu.cluster import mirror as mirror_mod
+from oryx_tpu.cluster.membership import Heartbeat, MembershipRegistry
+from oryx_tpu.cluster.mirror import (H_ORIGIN_OFFSET, H_ORIGIN_PARTITION,
+                                     H_ORIGIN_REGION, MirrorCheckpoint,
+                                     MirrorLayer)
+from oryx_tpu.common.config import from_dict
+from oryx_tpu.kafka.api import KEY_MODEL, KEY_UP
+from oryx_tpu.kafka.inproc import get_broker
+from oryx_tpu.lambda_rt.metrics import MetricsRegistry
+from oryx_tpu.obs.slo import SloEngine, SloObjective
+from oryx_tpu.resilience import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _mirror_config(tmp_path, src_name, dst_name,
+                   src_region="west", dst_region="east", **extra):
+    overlay = {
+        "oryx.cluster.region.name": dst_region,
+        "oryx.cluster.region.mirror.source-broker":
+            f"memory://{src_name}",
+        "oryx.cluster.region.mirror.source-region": src_region,
+        "oryx.cluster.region.mirror.checkpoint-dir":
+            str(tmp_path / f"ckpt-{dst_name}"),
+        "oryx.update-topic.broker": f"memory://{dst_name}",
+        "oryx.resilience.retry.max-attempts": 2,
+        "oryx.resilience.retry.initial-backoff-ms": 1,
+        "oryx.resilience.retry.max-backoff-ms": 2,
+    }
+    overlay.update(extra)
+    return from_dict(overlay)
+
+
+def _names():
+    """Unique broker names per test (the in-process registry is
+    process-global)."""
+    tag = uuid.uuid4().hex[:8]
+    return f"mw-{tag}", f"me-{tag}"
+
+
+def _records(broker, topic="OryxUpdate"):
+    end = broker.latest_offset(topic)
+    return broker.read_range(topic, 0, end)
+
+
+UP1 = '["X","u1",[1.0,2.0]]'
+UP2 = '["Y","i1",[3.0,4.0],["u1"]]'
+
+
+def test_replay_stamps_origin_headers_and_preserves_existing(tmp_path):
+    src_name, dst_name = _names()
+    src, dst = get_broker(src_name), get_broker(dst_name)
+    m = MirrorLayer(_mirror_config(tmp_path, src_name, dst_name))
+    try:
+        src.send("OryxUpdate", KEY_UP, UP1, headers={"ts": "1700"})
+        # an already-mirrored record (multi-hop): its birth coordinates
+        # must be preserved untouched, not re-stamped at this hop
+        src.send("OryxUpdate", KEY_UP, UP2, headers={
+            H_ORIGIN_REGION: "south", H_ORIGIN_PARTITION: "0",
+            H_ORIGIN_OFFSET: "99"})
+        assert m.poll_once() == 2
+        got = _records(dst)
+        assert [km.key for km in got] == [KEY_UP, KEY_UP]
+        assert got[0].headers == {"ts": "1700",
+                                  H_ORIGIN_REGION: "west",
+                                  H_ORIGIN_PARTITION: "0",
+                                  H_ORIGIN_OFFSET: "0"}
+        assert got[1].headers[H_ORIGIN_REGION] == "south"
+        assert got[1].headers[H_ORIGIN_OFFSET] == "99"
+        # a second poll replays nothing — the position advanced
+        assert m.poll_once() == 0
+        assert len(_records(dst)) == 2
+    finally:
+        m.close()
+
+
+def test_heartbeats_and_looped_records_are_dropped(tmp_path):
+    src_name, dst_name = _names()
+    src, dst = get_broker(src_name), get_broker(dst_name)
+    m = MirrorLayer(_mirror_config(tmp_path, src_name, dst_name))
+    try:
+        src.send("OryxUpdate", "HB", '{"replica":"r1"}')
+        # born in the DESTINATION region, bounced back through the
+        # opposite mirror: must never re-enter (A⇄B no ping-pong)
+        src.send("OryxUpdate", KEY_UP, UP1, headers={
+            H_ORIGIN_REGION: "east", H_ORIGIN_PARTITION: "0",
+            H_ORIGIN_OFFSET: "5"})
+        src.send("OryxUpdate", KEY_UP, UP2)
+        assert m.poll_once() == 1
+        got = _records(dst)
+        assert len(got) == 1 and got[0].message == UP2
+        counters = m.metrics.counters_snapshot()
+        assert counters["mirror_heartbeat_drops"] == 1
+        assert counters["mirror_loop_drops"] == 1
+        assert counters["mirror_records_replayed"] == 1
+    finally:
+        m.close()
+
+
+def test_checkpoint_round_trips_through_the_store(tmp_path):
+    ck = MirrorCheckpoint(str(tmp_path / "ck"))
+    ck.source[0] = 17
+    ck.advance_fence("west", 0, 41)
+    ck.dest_scanned[0] = 9
+    ck.save()
+    ck2 = MirrorCheckpoint(str(tmp_path / "ck"))
+    assert ck2.source == {0: 17}
+    assert ck2.watermarks == {("west", 0): 41}
+    assert ck2.dest_scanned == {0: 9}
+    assert ck2.behind_fence("west", 0, 41)
+    assert ck2.behind_fence("west", 0, 40)
+    assert not ck2.behind_fence("west", 0, 42)
+    assert not ck2.behind_fence("north", 0, 1)
+    # the fence never rewinds
+    ck2.advance_fence("west", 0, 3)
+    assert ck2.watermarks[("west", 0)] == 41
+
+
+def test_crash_between_replay_and_checkpoint_does_not_duplicate(tmp_path):
+    """The headline fence: kill the mirror AFTER a batch's sends but
+    BEFORE its checkpoint write — the restarted mirror re-reads the
+    batch and must skip every record (counted), leaving exactly one
+    copy of each fold-in in the destination log."""
+    src_name, dst_name = _names()
+    src, dst = get_broker(src_name), get_broker(dst_name)
+    cfg = _mirror_config(tmp_path, src_name, dst_name)
+    src.send("OryxUpdate", KEY_MODEL, "<PMML/>")
+    src.send("OryxUpdate", KEY_UP, UP1)
+    src.send("OryxUpdate", KEY_UP, UP2)
+
+    m1 = MirrorLayer(cfg)
+    m1.recover()
+    faults.inject("mirror-crash-mid-replay", mode="crash", times=1)
+    with pytest.raises(faults.InjectedCrash):
+        m1.poll_once()
+    assert faults.fired("mirror-crash-mid-replay") == 1
+    # the dangerous intermediate state: all three records SENT, source
+    # position and fence NOT durably advanced
+    assert len(_records(dst)) == 3
+    assert MirrorCheckpoint(str(tmp_path / f"ckpt-{dst_name}")
+                            ).source == {}
+
+    # "restart": recovery scans the destination log and re-derives the
+    # fence; the re-read batch dedups instead of re-sending
+    m2 = MirrorLayer(cfg)
+    try:
+        assert m2.recover() == 3
+        assert m2.poll_once() == 0
+        counters = m2.metrics.counters_snapshot()
+        assert counters["mirror_dedup_skips"] == 3
+        got = _records(dst)
+        assert len(got) == 3  # no duplicated fold-in effects
+        assert [km.message for km in got] == ["<PMML/>", UP1, UP2]
+        # and the fence is durable now: a third incarnation re-reads
+        # nothing at all
+        assert m2.poll_once() == 0
+    finally:
+        m2.close()
+        m1.close()
+
+
+def test_two_mirrors_a_b_never_ping_pong(tmp_path):
+    """A⇄B loop test: N records born in A replay into B exactly once;
+    B's mirror sees its copies, drops every one by origin, and the
+    total record count across both regions is bounded forever."""
+    a_name, b_name = _names()
+    a, b = get_broker(a_name), get_broker(b_name)
+    ab = MirrorLayer(_mirror_config(tmp_path, a_name, b_name,
+                                    src_region="west",
+                                    dst_region="east"))
+    ba = MirrorLayer(_mirror_config(tmp_path, b_name, a_name,
+                                    src_region="east",
+                                    dst_region="west"))
+    try:
+        n = 5
+        for i in range(n):
+            a.send("OryxUpdate", KEY_UP, f'["X","u{i}",[1.0]]')
+        b.send("OryxUpdate", KEY_UP, '["X","bu",[2.0]]')  # born in B
+        for _ in range(4):  # several full rounds: a loop would grow
+            ab.poll_once()
+            ba.poll_once()
+        a_recs, b_recs = _records(a), _records(b)
+        # A: its n originals + B's one mirrored record.  B: its one
+        # original + A's n mirrored records.  Nothing ping-ponged.
+        assert len(a_recs) == n + 1
+        assert len(b_recs) == n + 1
+        assert ba.metrics.counters_snapshot()["mirror_loop_drops"] == n
+        assert ab.metrics.counters_snapshot()["mirror_loop_drops"] == 1
+        # every mirrored record names its true birth region
+        assert {km.headers[H_ORIGIN_REGION] for km in b_recs
+                if km.headers and H_ORIGIN_REGION in km.headers} \
+            == {"west"}
+        assert {km.headers[H_ORIGIN_REGION] for km in a_recs
+                if km.headers and H_ORIGIN_REGION in km.headers} \
+            == {"east"}
+    finally:
+        ab.close()
+        ba.close()
+
+
+def test_staleness_gauges_climb_through_a_partitioned_link(tmp_path):
+    src_name, dst_name = _names()
+    src = get_broker(src_name)
+    m = MirrorLayer(_mirror_config(tmp_path, src_name, dst_name))
+    try:
+        src.send("OryxUpdate", KEY_UP, UP1,
+                 headers={"ts": str(int(time.time() * 1000) - 250)})
+        assert m.poll_once() == 1
+        # the drained batch carried a ts stamp: staleness is MEASURED
+        assert m._last_batch_staleness_ms >= 250
+        assert m.poll_once() == 0  # caught up: confirmation stamped
+        s0 = m.metrics.gauges_snapshot()["cross_region_staleness_ms"]
+        # partition the link: polls fail, lag holds, staleness climbs
+        faults.inject("mirror-link-partition", mode="error", times=None)
+        src.send("OryxUpdate", KEY_UP, UP2)
+        for _ in range(2):
+            with pytest.raises(ConnectionError):
+                m.poll_once()
+        time.sleep(0.03)
+        g1 = m.metrics.gauges_snapshot()
+        assert g1["cross_region_staleness_ms"] > s0
+        assert g1["mirror_lag_records"] == 1
+        time.sleep(0.03)
+        g2 = m.metrics.gauges_snapshot()
+        assert g2["cross_region_staleness_ms"] \
+            > g1["cross_region_staleness_ms"]
+        # heal: one poll drains the backlog and the gauges collapse
+        faults.clear("mirror-link-partition")
+        assert m.poll_once() == 1
+        assert m.poll_once() == 0
+        g3 = m.metrics.gauges_snapshot()
+        assert g3["mirror_lag_records"] == 0
+        assert g3["cross_region_staleness_ms"] \
+            < g2["cross_region_staleness_ms"]
+    finally:
+        m.close()
+
+
+def test_link_failure_holds_position_and_counts(tmp_path):
+    src_name, dst_name = _names()
+    src, dst = get_broker(src_name), get_broker(dst_name)
+    m = MirrorLayer(_mirror_config(tmp_path, src_name, dst_name))
+    try:
+        src.send("OryxUpdate", KEY_UP, UP1)
+        faults.inject("mirror-link-partition", mode="error", times=3)
+        for _ in range(3):
+            with pytest.raises(ConnectionError):
+                m.poll_once()
+        # the fault exhausted: the very next poll replays the backlog —
+        # nothing was lost or skipped while the link was down
+        assert m.poll_once() == 1
+        assert len(_records(dst)) == 1
+    finally:
+        m.close()
+
+
+def test_mirror_config_validation(tmp_path):
+    with pytest.raises(ValueError, match="region.name"):
+        MirrorLayer(from_dict({
+            "oryx.cluster.region.mirror.source-broker": "memory://x"}))
+    with pytest.raises(ValueError, match="source-broker"):
+        MirrorLayer(from_dict({"oryx.cluster.region.name": "east"}))
+    with pytest.raises(ValueError, match="checkpoint-dir"):
+        MirrorLayer(from_dict({
+            "oryx.cluster.region.name": "east",
+            "oryx.cluster.region.mirror.source-broker": "memory://x",
+            "oryx.update-topic.broker": "memory://y"}))
+    with pytest.raises(ValueError, match="self-mirror"):
+        MirrorLayer(from_dict({
+            "oryx.cluster.region.name": "east",
+            "oryx.cluster.region.mirror.source-broker": "memory://y",
+            "oryx.cluster.region.mirror.checkpoint-dir":
+                str(tmp_path / "ck"),
+            "oryx.update-topic.broker": "memory://y"}))
+
+
+def test_malformed_origin_headers_treated_as_source_born(tmp_path):
+    src_name, dst_name = _names()
+    src, dst = get_broker(src_name), get_broker(dst_name)
+    m = MirrorLayer(_mirror_config(tmp_path, src_name, dst_name))
+    try:
+        src.send("OryxUpdate", KEY_UP, UP1, headers={
+            H_ORIGIN_REGION: "south", H_ORIGIN_OFFSET: "not-a-number"})
+        assert m.poll_once() == 1
+        got = _records(dst)[0]
+        # re-stamped at this hop: identity must stay machine-usable
+        assert got.headers[H_ORIGIN_REGION] == "west"
+        assert got.headers[H_ORIGIN_OFFSET] == "0"
+    finally:
+        m.close()
+
+
+# -- region-pinned membership (multi-region defense in depth) ----------------
+
+
+def _hb(region=None, replica="r1"):
+    return Heartbeat(replica=replica, shard=0, of=1, url="http://x:1",
+                     generation=1, ready=True, fraction=1.0,
+                     region=region)
+
+
+def test_registry_rejects_foreign_region_heartbeats():
+    reg = MembershipRegistry(ttl_sec=60.0, region="east")
+    assert reg.note(_hb(region="east", replica="local"))
+    assert not reg.note(_hb(region="west", replica="foreign"))
+    assert reg.stale_topology_heartbeats == 1
+    # unstamped beats (single-region deployments, older replicas)
+    # always merge — back-compat
+    assert reg.note(_hb(region=None, replica="legacy"))
+    assert sorted(reg.snapshot()["replicas"]) == ["legacy", "local"]
+
+
+def test_regionless_registry_accepts_any_stamp():
+    reg = MembershipRegistry(ttl_sec=60.0)
+    assert reg.note(_hb(region="west", replica="w"))
+    assert reg.note(_hb(region=None, replica="n"))
+    assert reg.stale_topology_heartbeats == 0
+
+
+def test_heartbeat_json_region_round_trip_and_back_compat():
+    hb = _hb(region="east")
+    parsed = Heartbeat.from_json(hb.to_json())
+    assert parsed.region == "east"
+    # a region-less beat serializes WITHOUT the field (wire-compatible
+    # with pre-region consumers) and parses back as None
+    legacy = _hb(region=None).to_json()
+    assert "region" not in json.loads(legacy)
+    assert Heartbeat.from_json(legacy).region is None
+
+
+# -- kind="gauge" SLO objective (the staleness bound as a burn alert) --------
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_gauge_slo_objective_pages_on_sustained_breach():
+    registry = MetricsRegistry()
+    registry.set_gauge("cross_region_staleness_ms", 10.0)
+    obj = SloObjective("staleness", kind="gauge", target=0.99,
+                       gauge="cross_region_staleness_ms",
+                       max_value=5000.0)
+    clock = _Clock()
+    engine = SloEngine([obj], registry, resolution_sec=15.0,
+                       clock=clock)
+    engine.evaluate()
+    st = engine.status()["objectives"]["staleness"]
+    assert st["state"] == "ok" and st["gauge"] \
+        == "cross_region_staleness_ms"
+    # the region falls behind: sustained ticks over the bound burn the
+    # 1%-stale budget orders of magnitude too fast -> page
+    registry.set_gauge("cross_region_staleness_ms", 60000.0)
+    for _ in range(4):
+        clock.t += 16.0
+        engine.evaluate()
+    assert engine.status()["objectives"]["staleness"]["state"] == "page"
+    assert engine.burn_gauge() >= 14.4
+    # healed: good ticks past the fast windows clear the page
+    registry.set_gauge("cross_region_staleness_ms", 100.0)
+    for _ in range(300):
+        clock.t += 16.0
+        engine.evaluate()
+    assert engine.status()["objectives"]["staleness"]["state"] == "ok"
+
+
+def test_gauge_slo_objective_absent_gauge_casts_no_vote():
+    registry = MetricsRegistry()
+    obj = SloObjective("staleness", kind="gauge", target=0.99,
+                       gauge="never_registered", max_value=100.0)
+    clock = _Clock()
+    engine = SloEngine([obj], registry, resolution_sec=15.0,
+                       clock=clock)
+    for _ in range(5):
+        clock.t += 16.0
+        engine.evaluate()
+    st = engine.status()["objectives"]["staleness"]
+    assert st["state"] == "ok"
+    assert st["windows"]["5m"]["total"] == 0
+
+
+def test_gauge_slo_objective_requires_gauge_name():
+    with pytest.raises(ValueError, match="kind=gauge"):
+        SloObjective("bad", kind="gauge", max_value=5.0)
+
+
+def test_gauge_slo_objective_rejects_watching_the_engines_own_exports():
+    # slo_* gauge fns call evaluate() — watching one would deadlock
+    # evaluation on its own (non-reentrant) lock
+    with pytest.raises(ValueError, match="slo_burn_rate"):
+        SloObjective("bad", kind="gauge", gauge="slo_burn_rate",
+                     max_value=5.0)
+
+
+def test_gauge_slo_objective_requires_positive_bound():
+    # an implicit max-value of 0 would count every positive reading
+    # bad: a page that never clears
+    with pytest.raises(ValueError, match="max-value"):
+        SloObjective("bad", kind="gauge",
+                     gauge="cross_region_staleness_ms")
+
+
+def test_lag_gauge_is_unknown_until_the_source_is_first_observed(
+        tmp_path):
+    """A mirror restarted INTO a partition must report lag as None
+    (unknown), never a seeded 0 that the failover runbook would read
+    as 'caught up'; once the source HAS been observed, a later outage
+    holds the last real value."""
+    src_name, dst_name = _names()
+    get_broker(src_name).send("OryxUpdate", KEY_UP, UP1)
+    m = MirrorLayer(_mirror_config(tmp_path, src_name, dst_name))
+    real_resolve = mirror_mod.resolve_broker
+
+    def dead_link(uri):
+        raise ConnectionError("link down")
+
+    try:
+        # dead link from birth: the source has never been reachable
+        mirror_mod.resolve_broker = dead_link
+        assert m._lag_gauge() is None
+        assert m.metrics.gauges_snapshot()["mirror_lag_records"] is None
+        # link up: lag becomes a real observation...
+        mirror_mod.resolve_broker = real_resolve
+        assert m._lag_gauge() == 1
+        # ...and a later outage HOLDS it instead of forgetting it
+        mirror_mod.resolve_broker = dead_link
+        assert m._lag_gauge() == 1
+    finally:
+        mirror_mod.resolve_broker = real_resolve
+        m.close()
+
+
+def test_engine_from_config_parses_gauge_objective():
+    from oryx_tpu.obs.slo import engine_from_config
+    cfg = from_dict({
+        "oryx.obs.slo.enabled": True,
+        "oryx.obs.slo.objectives.staleness.kind": "gauge",
+        "oryx.obs.slo.objectives.staleness.gauge":
+            "cross_region_staleness_ms",
+        "oryx.obs.slo.objectives.staleness.max-value": 5000,
+        "oryx.obs.slo.objectives.staleness.target": 0.99,
+    })
+    engine = engine_from_config(cfg, MetricsRegistry())
+    (obj,) = engine.objectives
+    assert obj.kind == "gauge"
+    assert obj.gauge == "cross_region_staleness_ms"
+    assert obj.max_value == 5000.0
+
+
+# -- headless /metrics surface (ISSUE 11 satellite) --------------------------
+
+
+def test_obs_server_metrics_exposes_resilience_block(tmp_path):
+    """The headless tiers (speed, batch, mirror) run producers behind
+    retries/breakers but had no way to SEE them: the side-door
+    /metrics must carry the same resilience block the serving tier
+    and router expose — and the mirror's /admin/slo must serve its
+    staleness objective's alert state on the same port."""
+    src_name, dst_name = _names()
+    cfg = _mirror_config(
+        tmp_path, src_name, dst_name,
+        **{"oryx.obs.metrics-port": 0,
+           "oryx.obs.slo.enabled": True,
+           "oryx.obs.slo.objectives.staleness.kind": "gauge",
+           "oryx.obs.slo.objectives.staleness.gauge":
+               "cross_region_staleness_ms",
+           "oryx.obs.slo.objectives.staleness.max-value": 5000,
+           "oryx.obs.slo.objectives.staleness.target": 0.99})
+    m = MirrorLayer(cfg)
+    try:
+        m.obs_server.start()
+        port = m.obs_server.port
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+            snap = json.loads(r.read())
+        # the mirror's own named policies are visible where its gauges
+        # already were
+        assert snap["resilience"]["mirror-replay"]["kind"] == "retry"
+        assert snap["resilience"]["mirror-replay-dest"]["state"] \
+            == "closed"
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/admin/region",
+                timeout=10) as r:
+            region = json.loads(r.read())
+        assert region["region"] == "east"
+        assert region["role"] == "mirror"
+        assert region["source_region"] == "west"
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/admin/slo", timeout=10) as r:
+            slo = json.loads(r.read())
+        assert slo["objectives"]["staleness"]["kind"] == "gauge"
+        assert snap["freshness"]["slo_burn_rate"] is not None
+    finally:
+        m.close()

@@ -44,7 +44,8 @@ _GAUGE_METHODS = {"set_gauge": 0, "gauge_fn": 0}
 # tier with an HTTP surface — router, serving, and the headless
 # tiers' side-door ObsServer — not literals the AST walk can see
 _DYNAMIC_REQUEST_SPANS = {"router.request", "serving.request",
-                          "speed.request", "batch.request"}
+                          "speed.request", "batch.request",
+                          "mirror.request"}
 
 
 def _literal_arg(call: ast.Call, index: int) -> str | None:

@@ -13,7 +13,8 @@ from oryx_tpu.resilience import faults
 from oryx_tpu.resilience.policy import (Backoff, CircuitBreaker,
                                         CircuitOpenError, Deadline,
                                         DeadlineExceeded, Retry,
-                                        Supervisor, resilience_snapshot)
+                                        Supervisor, resilience_snapshot,
+                                        run_with_resubscribe)
 
 
 @pytest.fixture(autouse=True)
@@ -276,6 +277,82 @@ def test_supervisor_gives_up_after_max_restarts():
     with pytest.raises(RuntimeError, match="exceeded 2 restarts"):
         sup.run()
     assert sup.restarts == 2
+
+
+# -- run_with_resubscribe ----------------------------------------------------
+# Direct unit coverage (ISSUE 11 satellite): the speed/serving/router
+# consumers and the mirror all run inside this loop — its backoff and
+# stop semantics ARE their failover latency.
+
+
+def test_resubscribe_restarts_failed_subscription_until_clean_end():
+    stop = threading.Event()
+    calls = []
+
+    def fn():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("broker gone")
+        stop.set()  # clean end: the subscription saw stop and returned
+
+    run_with_resubscribe(fn, stop, "t-sub",
+                         backoff=Backoff(0.001, 0.002, jitter=0.0))
+    assert len(calls) == 3
+
+
+def test_resubscribe_backoff_resets_after_healthy_run():
+    # two quick failures walk the backoff up; then a LONG healthy run
+    # fails — the next resubscribe must wait the INITIAL backoff again,
+    # not the lifetime-accumulated schedule (a mirror that ran for days
+    # must not add a maxed-out sleep to its failover)
+    clock = _Clock()
+    stop = threading.Event()
+    sleeps = []
+
+    class _Stop:
+        def is_set(self):
+            return stop.is_set()
+
+        def wait(self, t):
+            sleeps.append(round(t, 4))
+            return stop.wait(0)
+
+    calls = []
+
+    def fn():
+        calls.append(1)
+        if len(calls) == 3:
+            clock.t += 1000.0  # ran healthily for a long time
+        if len(calls) < 5:
+            raise ConnectionError("down")
+        stop.set()
+
+    run_with_resubscribe(fn, _Stop(), "t-sub-reset",
+                         backoff=Backoff(0.01, 10.0, jitter=0.0),
+                         healthy_reset_sec=300.0, clock=clock)
+    # attempts 1, 2 escalate; attempt after the healthy run restarts
+    # the schedule at the initial delay
+    assert sleeps == [0.01, 0.02, 0.01, 0.02]
+
+
+def test_resubscribe_stop_during_backoff_sleep_returns_promptly():
+    # the inter-attempt sleep must be interruptible: a shutdown (or a
+    # supervised mirror failover) during a long backoff must not wait
+    # it out
+    stop = threading.Event()
+    t_probe = {}
+
+    def fn():
+        if "t0" not in t_probe:
+            t_probe["t0"] = time.monotonic()
+            # stop lands while the loop sleeps the (huge) backoff
+            threading.Timer(0.05, stop.set).start()
+            raise ConnectionError("first failure")
+        raise AssertionError("must not resubscribe after stop")
+
+    run_with_resubscribe(fn, stop, "t-sub-stop",
+                         backoff=Backoff(60.0, 60.0, jitter=0.0))
+    assert time.monotonic() - t_probe["t0"] < 10.0
 
 
 # -- fault registry ----------------------------------------------------------

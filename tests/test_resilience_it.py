@@ -646,6 +646,65 @@ def test_generation_offsets_header_roundtrip(tmp_path):
         ["a", "b"]
 
 
+# -- record headers through the resilient producer (ISSUE 11 satellite) ------
+# The mirror's exactly-once-effective fence keys on the
+# origin-region/origin-offset headers and the staleness gauges on `ts`:
+# a RETRIED send that dropped or doubled them would silently break both.
+
+
+def _headered_send_producer(broker_name):
+    from oryx_tpu.kafka.inproc import InProcTopicProducer
+    from oryx_tpu.resilience.policy import (Backoff,
+                                            ResilientTopicProducer, Retry)
+    return ResilientTopicProducer(
+        InProcTopicProducer(f"memory://{broker_name}", "HdrT"),
+        retry=Retry("t-hdr-send", max_attempts=3,
+                    backoff=Backoff(0.001, 0.002, jitter=0.0)))
+
+
+def test_headers_survive_injected_retry_exactly_once():
+    broker = get_broker("hdr1")
+    producer = _headered_send_producer("hdr1")
+    headers = {"origin-region": "west", "origin-offset": "41",
+               "ts": "1700000000000"}
+    faults.inject("inproc-send", mode="error", times=1)
+    producer.send(KEY_UP, '["X","u1",[1.0]]', headers=headers)
+    assert faults.fired("inproc-send") == 1
+    records = _drain(broker, "HdrT")
+    # exactly one record landed (the failed attempt appended nothing)
+    # and it carries EXACTLY the headers the caller attached
+    assert len(records) == 1
+    assert records[0].headers == headers
+
+
+def test_headers_ride_every_copy_of_a_duplicated_delivery():
+    # producer-retry duplication (the ambiguous-ack case): BOTH copies
+    # must carry the full header set — a consumer deduping on
+    # origin-offset sees the same identity twice and keeps one effect
+    broker = get_broker("hdr2")
+    producer = _headered_send_producer("hdr2")
+    headers = {"origin-region": "west", "origin-offset": "7"}
+    faults.inject("inproc-send", mode="duplicate", times=1)
+    producer.send(KEY_UP, '["X","u2",[1.0]]', headers=headers)
+    records = _drain(broker, "HdrT")
+    assert len(records) == 2
+    assert all(km.headers == headers for km in records)
+    assert len({km.headers["origin-offset"] for km in records}) == 1
+
+
+def test_headerless_send_still_works_through_retry():
+    # the widened send signature must stay optional end to end: a
+    # header-free payload retried through the same producer lands with
+    # headers absent, not {}-polluted
+    broker = get_broker("hdr3")
+    producer = _headered_send_producer("hdr3")
+    faults.inject("inproc-send", mode="error", times=1)
+    producer.send(KEY_UP, '["X","u3",[1.0]]')
+    records = _drain(broker, "HdrT")
+    assert len(records) == 1
+    assert records[0].headers is None
+
+
 # -- delivery under injected duplication -------------------------------------
 
 def test_duplicated_delivery_is_absorbed_by_batch_idempotence(tmp_path):
