@@ -20,6 +20,9 @@ Three artifact families share the machinery, selected by ``--kind``:
   lacking-cell-is-new back-compat.  Since r13 the ``--regions 2``
   mirror probe (ISSUE 11) gates as the ``(..., "mirror")``
   pseudo-cell on healed-partition catch-up speed (records/s), same
+  back-compat.  Since r14 the connection-count rung (ISSUE 12, C10K
+  front end) gates as the ``(..., "conns")`` pseudo-cell on qps
+  sustained through the top rung's concurrent sockets, same
   back-compat.
 - ``obs``: ``BENCH_OBS_OVERHEAD_*.json`` — the observability
   hot-path microbench (bench/obs_overhead.py).  Gates on two rules:
@@ -188,6 +191,27 @@ def _cells(doc: dict) -> dict:
                     "catch_up_s": mir.get("catch_up_s"),
                     "steady_staleness_ms":
                         mir.get("steady_staleness_ms"),
+                }
+            # r14 added the connection-count rung (C10K front end,
+            # ISSUE 12): it gates as its own (..., "conns")
+            # pseudo-cell on the qps sustained THROUGH the top rung's
+            # concurrent sockets, so a front-end regression (the
+            # event loop losing throughput at high connection counts,
+            # or errors appearing — errors zero the gated number)
+            # cannot hide behind a healthy low-concurrency cell.
+            # Socket and router-thread telemetry ride along for
+            # diagnosis.  Pre-r14 artifacts simply lack the cell.
+            conns = r.get("conns")
+            if isinstance(conns, dict) \
+                    and conns.get("open_loop_sustained_qps") \
+                    is not None:
+                out[key + ("conns",)] = {
+                    "open_loop_sustained_qps":
+                        conns["open_loop_sustained_qps"],
+                    "connections": conns.get("connections"),
+                    "router_threads_at_load":
+                        conns.get("router_threads_at_load"),
+                    "hit_p50_ms": conns.get("hit_p50_ms"),
                 }
         return out
     return {(r["features"], r["items"], r["lsh"]): r

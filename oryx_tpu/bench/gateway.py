@@ -60,10 +60,24 @@ records per-replica ``model_load_s``/slice bytes/fallbacks, and
 ``--load-compare N`` publishes the same catalog both ways and boots
 the same fleet against each (the O(catalog/N) load evidence).
 
-Writes ``BENCH_GATEWAY_r12.json``; ``bench/check_regression.py
+Since r14 the router runs the C10K stack by default: ``--async`` (the
+asyncio event-loop front end, ``--no-async`` reproduces the threaded
+r13 configuration exactly) and ``--transport`` (the multiplexed framed
+internal hop; ``--no-transport`` falls back to the HTTP/1.1 pool).
+``--connections C1,C2,...`` adds a connection-count rung ladder: C
+concurrent keep-alive sockets drive the cache-hit workload with
+per-rung open-socket and ROUTER THREAD-COUNT telemetry — the measured
+form of "the ceiling is file descriptors, not thread stacks".  Cells
+with replica groups (R>1) additionally run a hedge-frame probe: a
+dedicated hedge-eager router proves a hedge costs a frame, not a
+connection (transport connections per replica stay 1 through the
+storm).  ``--replica-cache`` arms the replica-side result cache
+(cluster/result_cache.py ShardResultCache) on every replica.
+
+Writes ``BENCH_GATEWAY_r14.json``; ``bench/check_regression.py
 --kind gateway`` gates successive rounds per (features, items,
-replicas, replicas-per-shard) cell, plus ``zipf`` and ``load``
-pseudo-cells per row when those rungs ran.
+replicas, replicas-per-shard) cell, plus ``zipf`` / ``load`` /
+``mirror`` / ``conns`` pseudo-cells per row when those rungs ran.
 """
 
 from __future__ import annotations
@@ -288,6 +302,173 @@ def _coalesce_burst_probe(port: int, user_ids: list[str],
     return out
 
 
+def _proc_threads(pid: int) -> int | None:
+    """The process's live thread count from /proc — the per-rung
+    telemetry that proves connections stopped costing stacks."""
+    try:
+        with open(f"/proc/{pid}/status", encoding="ascii") as f:
+            for line in f:
+                if line.startswith("Threads:"):
+                    return int(line.split()[1])
+    except (OSError, ValueError):
+        return None
+    return None
+
+
+def _connection_scale_probe(port: int, pid: int, user_ids: list[str],
+                            connections: int,
+                            duration_sec: float = 8.0,
+                            hot_users: int = 32,
+                            client_threads: int = 8) -> dict:
+    """The C10K rung: ``connections`` concurrent keep-alive sockets
+    all driving the cache-hit workload (a small hot user set, primed
+    first), served round-robin by a few client threads — the client
+    deliberately has FAR fewer threads than sockets, exactly like the
+    server under test.  Records 200s/errors, the cached-hit latency
+    split, the router's thread count at full connection load, and the
+    open-socket count."""
+    import socket as sock_mod
+    import threading as th
+    hot = user_ids[:hot_users]
+    for uid in hot:
+        _get_json(port, f"/recommend/{uid}?howMany=10")
+    socks = []
+    for _ in range(connections):
+        s = sock_mod.create_connection(("127.0.0.1", port), timeout=30)
+        s.setsockopt(sock_mod.IPPROTO_TCP, sock_mod.TCP_NODELAY, 1)
+        socks.append((s, s.makefile("rb")))
+    ok = [0]
+    errors = [0]
+    hit_lat: list[float] = []
+    verdicts: dict[str, int] = {}
+    lock = th.Lock()
+    t_end = time.monotonic() + duration_sec
+    threads_mid = [None]
+
+    def worker(my: list) -> None:
+        while time.monotonic() < t_end:
+            for j, (s, rf) in enumerate(my):
+                if time.monotonic() >= t_end:
+                    return
+                uid = hot[j % len(hot)]
+                t0 = time.monotonic()
+                try:
+                    s.sendall(
+                        f"GET /recommend/{uid}?howMany=10 HTTP/1.1"
+                        "\r\nHost: a\r\n\r\n".encode("latin-1"))
+                    status_line = rf.readline(65537)
+                    status = int(status_line.split(b" ", 2)[1])
+                    clen, verdict = 0, None
+                    while True:
+                        h = rf.readline(65537)
+                        if h in (b"\r\n", b"\n", b""):
+                            break
+                        if h[:15].lower() == b"content-length:":
+                            clen = int(h[15:])
+                        elif h[:13].lower() == b"x-oryx-cache:":
+                            verdict = h[13:].strip().decode("latin-1")
+                    remaining = clen
+                    while remaining:
+                        got = rf.read(remaining)
+                        if not got:
+                            raise ConnectionError("short body")
+                        remaining -= len(got)
+                except Exception:  # noqa: BLE001 — counted
+                    with lock:
+                        errors[0] += 1
+                    return
+                ms = (time.monotonic() - t0) * 1000.0
+                with lock:
+                    if status == 200:
+                        ok[0] += 1
+                    else:
+                        errors[0] += 1
+                    if verdict:
+                        verdicts[verdict] = verdicts.get(verdict, 0) + 1
+                        if verdict == "hit":
+                            hit_lat.append(ms)
+
+    chunk = max(1, connections // client_threads)
+    workers = [th.Thread(target=worker,
+                         args=(socks[i:i + chunk],), daemon=True)
+               for i in range(0, connections, chunk)]
+    for w in workers:
+        w.start()
+    time.sleep(duration_sec / 2)
+    threads_mid[0] = _proc_threads(pid)
+    for w in workers:
+        w.join(duration_sec + 60.0)
+    out = {
+        "connections": connections,
+        "open_sockets": len(socks),
+        "ok_200": ok[0],
+        "errors": errors[0],
+        "achieved_qps": round(ok[0] / duration_sec, 1),
+        "open_loop_sustained_qps": round(ok[0] / duration_sec, 1)
+        if errors[0] == 0 else 0.0,
+        "router_threads_at_load": threads_mid[0],
+        "verdicts": verdicts,
+    }
+    if hit_lat:
+        out["hit_p50_ms"] = round(float(np.percentile(hit_lat, 50)), 3)
+        out["hit_p99_ms"] = round(float(np.percentile(hit_lat, 99)), 3)
+    for s, rf in socks:
+        try:
+            s.close()
+        except OSError:
+            pass
+    return out
+
+
+def _hedge_frame_probe(work_dir: str, broker_dir: str,
+                       user_ids: list[str], extra_conf: dict,
+                       shards: int, requests: int = 150) -> dict:
+    """Hedge-cost evidence on the framed transport: a dedicated
+    hedge-EAGER router (hedge-after 1 ms, cache off) over the cell's
+    live replicas — every slow-ish answer hedges, and the probe reads
+    back how many hedges fired vs how many transport connections per
+    replica exist.  The claim under test: hedges cost a frame, never a
+    connection (sockets per replica stay 1 through the storm)."""
+    port = _free_port()
+    conf = os.path.join(work_dir, "hedge-probe-router.conf")
+    _write_conf(conf, broker_dir, port, {
+        **extra_conf,
+        "oryx.cluster.transport.enabled": True,
+        "oryx.cluster.hedge-after-ms": 1,
+    })
+    log_path = os.path.join(work_dir, "hedge-probe.log")
+    proc = _spawn(["router"], conf, None, log_path)
+    try:
+        _await(lambda: _get_json(port, "/metrics")
+               ["cluster"]["covered_shards"] == list(range(shards)),
+               "hedge probe coverage")
+        for i in range(requests):
+            uid = user_ids[i % len(user_ids)]
+            _get_json(port, f"/recommend/{uid}?howMany=10&hp={i}")
+        m = _get_json(port, "/metrics")["cluster"]["scatter"]
+        tp = m.get("transport") or {}
+        contacted = len(tp.get("per_replica", {}))
+        open_conns = tp.get("open_connections", 0)
+        return {
+            "requests": requests,
+            "hedges": m.get("hedges"),
+            "hedge_abandoned": m.get("hedge_abandoned"),
+            "cancels_sent": tp.get("cancels_sent"),
+            "transport_connections": open_conns,
+            "replicas_contacted": contacted,
+            # THE number: sockets per replica through the hedge storm
+            # (1.0 = every hedge cost a frame, never a connection)
+            "sockets_per_replica": round(open_conns / contacted, 2)
+            if contacted else None,
+        }
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
 def _await(predicate, what: str, timeout: float = 300.0) -> None:
     t_end = time.monotonic() + timeout
     while time.monotonic() < t_end:
@@ -464,7 +645,11 @@ def run_cell(replicas: int, items: int, features: int, users: int,
              cache: bool = True,
              zipf: float = 0.0,
              coalesce_burst: int = 0,
-             sharded_publish: int = 0) -> dict:
+             sharded_publish: int = 0,
+             async_mode: bool = False,
+             transport: bool = False,
+             replica_cache: bool = False,
+             connections: "list[int] | None" = None) -> dict:
     publish_s = 0.0
     if broker_dir is None:
         broker_dir = os.path.join(work_dir, f"broker-{replicas}")
@@ -507,6 +692,12 @@ def run_cell(replicas: int, items: int, features: int, users: int,
                     f"s{s}r{r}of{replicas}",
                 **obs_extra,
             }
+            if transport:
+                # the framed internal hop: frame listener next to the
+                # HTTP door, port advertised via the heartbeat
+                extra["oryx.cluster.transport.enabled"] = True
+            if replica_cache:
+                extra["oryx.cluster.replica-cache.enabled"] = True
             if device_ms_per_mrow > 0:
                 # fixed-rate accelerator emulation: each scoring
                 # dispatch sleeps for the time a device streaming this
@@ -549,6 +740,12 @@ def run_cell(replicas: int, items: int, features: int, users: int,
         conf = os.path.join(
             work_dir, f"router-{replicas}x{replicas_per_shard}.conf")
         router_extra = dict(obs_extra)
+        if async_mode:
+            # the C10K event-loop front end (--no-async reproduces the
+            # threaded r13 router exactly)
+            router_extra["oryx.cluster.async.enabled"] = True
+        if transport:
+            router_extra["oryx.cluster.transport.enabled"] = True
         if device_ms_per_mrow > 0:
             # hedge only on a genuine stall: the default 100 ms window
             # sits far BELOW an emulated cell's per-dispatch delay, so
@@ -712,6 +909,35 @@ def run_cell(replicas: int, items: int, features: int, users: int,
         if cache and coalesce_burst > 1:
             burst_report = _coalesce_burst_probe(
                 router_port, user_ids, coalesce_burst)
+
+        # connection-count rung ladder (C10K acceptance): C concurrent
+        # keep-alive sockets on the cache-hit workload, with open-
+        # socket and router-thread telemetry per rung — only
+        # meaningful with the cache armed (hits are the workload)
+        conns_report = None
+        if cache and connections:
+            router_pid = procs[-1].pid
+            rungs = []
+            for cnum in connections:
+                rung = _connection_scale_probe(
+                    router_port, router_pid, user_ids, cnum,
+                    duration_sec=max(6.0, duration_sec))
+                rungs.append(rung)
+                print(json.dumps(rung), file=sys.stderr)
+            top = rungs[-1]
+            conns_report = {**top, "rungs": rungs,
+                            "router_threads_idle":
+                                _proc_threads(router_pid)}
+
+        # hedge-cost probe (framed transport, replica groups only): a
+        # dedicated hedge-eager router proves hedges cost a frame, not
+        # a connection
+        hedge_frames = None
+        if transport and replicas_per_shard > 1:
+            hedge_frames = _hedge_frame_probe(
+                work_dir, broker_dir, user_ids, dict(obs_extra),
+                replicas)
+            print(json.dumps(hedge_frames), file=sys.stderr)
         if best and best.get("worst_sampled"):
             # worst sampled requests of the best rung: each trace id
             # names a recorded span tree on the router's /admin/traces
@@ -775,8 +1001,13 @@ def run_cell(replicas: int, items: int, features: int, users: int,
             "sustained_p50_ms": best["p50_ms"] if best else None,
             "sustained_p95_ms": best["p95_ms"] if best else None,
             "cache_armed": cache,
+            "async_front_end": async_mode,
+            "framed_transport": transport,
+            "replica_cache_armed": replica_cache,
             "zipf": zipf_report,
             "coalesce_burst": burst_report,
+            "conns": conns_report,
+            "hedge_frames": hedge_frames,
             "cache_stats_after_run": _cache_stats(router_port),
             "kill_probe": kill_probe,
             "admission": admission or None,
@@ -1049,6 +1280,36 @@ def main(argv: list[str] | None = None) -> int:
                          "IDENTICAL concurrent requests against a "
                          "cold key — the herd must collapse to one "
                          "scatter (verdicts tallied).  0 = off")
+    ap.add_argument("--async", dest="async_mode",
+                    action=argparse.BooleanOptionalAction, default=True,
+                    help="run the router on the asyncio event-loop "
+                         "front end (oryx.cluster.async.enabled); "
+                         "--no-async reproduces the threaded r13 "
+                         "router exactly")
+    ap.add_argument("--transport",
+                    action=argparse.BooleanOptionalAction, default=True,
+                    help="run the internal hop on the multiplexed "
+                         "framed transport (one persistent connection "
+                         "per replica); --no-transport falls back to "
+                         "the HTTP/1.1 socket pool")
+    ap.add_argument("--replica-cache",
+                    action=argparse.BooleanOptionalAction,
+                    default=False,
+                    help="arm the replica-side result cache "
+                         "(oryx.cluster.replica-cache.enabled) on "
+                         "every replica — repeated identical shard "
+                         "queries under an unchanged model epoch skip "
+                         "the device.  Off by default so the "
+                         "uniform-cold cell stays an honest miss-path "
+                         "measurement")
+    ap.add_argument("--connections", default="",
+                    help="comma ladder of concurrent keep-alive "
+                         "socket counts (e.g. 256,1024,4096): each "
+                         "rung drives the cache-hit workload through "
+                         "that many sockets and records open-socket + "
+                         "router-thread telemetry; the top rung gates "
+                         "as the (..., 'conns') pseudo-cell.  Empty = "
+                         "off")
     ap.add_argument("--sharded-publish", type=int, default=24,
                     help="publish the model as this many murmur2 "
                          "slices + a manifest-carrying MODEL-REF (no "
@@ -1075,7 +1336,7 @@ def main(argv: list[str] | None = None) -> int:
                          "each, recording replay vs sliced load times "
                          "and their ratio (the O(catalog/N) "
                          "acceptance evidence).  0 = off")
-    ap.add_argument("--out", default="BENCH_GATEWAY_r12.json")
+    ap.add_argument("--out", default="BENCH_GATEWAY_r14.json")
     ap.add_argument("--keep-work", action="store_true")
     args = ap.parse_args(argv)
 
@@ -1156,7 +1417,12 @@ def main(argv: list[str] | None = None) -> int:
                 cache=args.cache,
                 zipf=args.zipf,
                 coalesce_burst=args.coalesce_burst,
-                sharded_publish=args.sharded_publish)
+                sharded_publish=args.sharded_publish,
+                async_mode=args.async_mode,
+                transport=args.transport,
+                replica_cache=args.replica_cache,
+                connections=[int(x) for x in
+                             args.connections.split(",") if x])
             row["publish_s"] = publish_s
             if mirror_probe is not None and not rows:
                 # the probe rides the FIRST row as its (..., "mirror")
@@ -1176,6 +1442,10 @@ def main(argv: list[str] | None = None) -> int:
     report = {
         "metric": "gateway_recommend_scaling",
         "cache_armed": args.cache,
+        "async_front_end": args.async_mode,
+        "framed_transport": args.transport,
+        "replica_cache_armed": args.replica_cache,
+        "connections": args.connections or None,
         "sharded_publish": args.sharded_publish or None,
         "load_compare": load_compare,
         "regions": args.regions,

@@ -105,10 +105,15 @@ class Heartbeat:
     # misconfigured shared topic must still never route a router at
     # replicas it cannot reach across the region boundary
     region: str | None = None
+    # framed-transport listener port (cluster/transport.py; None =
+    # the replica speaks only HTTP/1.1 internally).  The router falls
+    # back to the HTTP hop per replica, so a mixed fleet mid-rollout
+    # keeps serving
+    tport: int | None = None
 
     def to_json(self) -> str:
         d = {k: v for k, v in self.__dict__.items()
-             if not (k == "region" and v is None)}
+             if not (k in ("region", "tport") and v is None)}
         return json.dumps(d, separators=(",", ":"))
 
     @classmethod
@@ -116,13 +121,15 @@ class Heartbeat:
         try:
             d = json.loads(s)
             region = d.get("region")
+            tport = d.get("tport")
             return cls(replica=str(d["replica"]), shard=int(d["shard"]),
                        of=int(d["of"]), url=str(d["url"]),
                        generation=int(d["generation"]),
                        ready=bool(d["ready"]),
                        fraction=float(d.get("fraction", 0.0)),
                        ts=float(d.get("ts", 0.0)),
-                       region=None if region is None else str(region))
+                       region=None if region is None else str(region),
+                       tport=None if tport is None else int(tport))
         except (ValueError, TypeError, KeyError):
             return None  # malformed control message: ignore, don't die
 
@@ -516,7 +523,8 @@ class HeartbeatPublisher:
                  manager, min_fraction: float,
                  interval_sec: float = 0.5,
                  replica_id: str | None = None,
-                 region: str | None = None):
+                 region: str | None = None,
+                 tport: int | None = None):
         self._producer = producer
         self.shard = shard
         self.of = of
@@ -526,6 +534,7 @@ class HeartbeatPublisher:
         self.interval_sec = interval_sec
         self.replica_id = replica_id or uuid.uuid4().hex[:12]
         self.region = region
+        self.tport = tport
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.published = 0
@@ -538,7 +547,8 @@ class HeartbeatPublisher:
             url=self.url,
             generation=int(getattr(self._manager, "generation", 0)),
             ready=model is not None and fraction >= self._min_fraction,
-            fraction=fraction, ts=time.time(), region=self.region)
+            fraction=fraction, ts=time.time(), region=self.region,
+            tport=self.tport)
 
     def publish_once(self) -> bool:
         if faults.fire("replica-heartbeat-drop") == "drop":

@@ -86,7 +86,8 @@ from ..resilience import faults
 
 _monotonic = time.monotonic
 
-__all__ = ["ResultCache", "CacheEntry", "CacheProbe", "route_tags"]
+__all__ = ["ResultCache", "CacheEntry", "CacheProbe", "route_tags",
+           "ShardResultCache"]
 
 
 def _ids_of_segments(raw: str) -> tuple[str, ...]:
@@ -184,13 +185,17 @@ class CacheEntry:
 
 
 class _Flight:
-    __slots__ = ("key", "event", "entry", "done")
+    __slots__ = ("key", "event", "entry", "done", "waiters")
 
     def __init__(self, key: tuple):
         self.key = key
         self.event = threading.Event()
         self.entry: CacheEntry | None = None
         self.done = False
+        # completion callbacks for waiters that must not block a
+        # thread on `event` — the async front end parks a coroutine
+        # here and is woken via loop.call_soon_threadsafe
+        self.waiters: list = []
 
 
 # gzip threshold mirrors lambda_rt.http._send: small bodies are not
@@ -307,6 +312,26 @@ class ResultCache:
             if entry is None:
                 self.misses += 1
                 self._metrics.inc("cache_misses")
+                return None
+            self._entries.move_to_end(probe.key)
+            self.hits += 1
+            self._metrics.inc("cache_hits")
+            if entry.status != 200:
+                self.negative_hits += 1
+                self._metrics.inc("cache_negative_hits")
+            return entry
+
+    def lookup_present(self, probe: CacheProbe) -> CacheEntry | None:
+        """Hit-or-nothing lookup for the async front end's on-loop
+        fast path: a present entry counts (and serves) exactly like
+        :meth:`lookup`; an ABSENT key is not counted as a miss — the
+        bridged full dispatch re-probes the same request and counts
+        its miss exactly once."""
+        if not self.store_enabled:
+            return None
+        with self._lock:
+            entry = self._entries.get(probe.key)
+            if entry is None:
                 return None
             self._entries.move_to_end(probe.key)
             self.hits += 1
@@ -612,6 +637,35 @@ class ResultCache:
             self.coalesce_fallthroughs += 1
         return "solo", None
 
+    def flight_for(self, key: tuple) -> "_Flight | None":
+        """The in-flight leader for a key, if any — the async front
+        end joins it on-loop instead of parking a thread."""
+        if not self.coalesce:
+            return None
+        with self._lock:
+            return self._flights.get(key)
+
+    def add_flight_waiter(self, flight: _Flight, callback) -> bool:
+        """Register a completion callback on an in-flight leader.
+        Returns False when the flight already finished (the caller
+        reads ``flight.entry`` directly instead of waiting).  The
+        callback runs on the LEADER's thread at finish time and must
+        be cheap and non-raising (the async front end passes
+        ``loop.call_soon_threadsafe``)."""
+        with self._lock:
+            if flight.done:
+                return False
+            flight.waiters.append(callback)
+            return True
+
+    def count_coalesced(self) -> None:
+        """Count one follower served from a leader's flight — the
+        async front end's on-loop join path (begin_flight counts the
+        thread-parked form itself)."""
+        with self._lock:
+            self.coalesced += 1
+        self._metrics.inc("coalesced_requests")
+
     def finish_flight(self, flight: _Flight,
                       entry: CacheEntry | None) -> None:
         """Publish the leader's outcome (idempotent; entry None =
@@ -621,9 +675,15 @@ class ResultCache:
                 return
             flight.done = True
             flight.entry = entry
+            waiters, flight.waiters = flight.waiters, []
             if self._flights.get(flight.key) is flight:
                 del self._flights[flight.key]
         flight.event.set()
+        for cb in waiters:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 — waiters are best-effort
+                pass
 
     # -- operator surface ----------------------------------------------------
 
@@ -651,4 +711,170 @@ class ResultCache:
                 "negative_stores": self.negative_stores,
                 "negative_hits": self.negative_hits,
                 "in_flight": len(self._flights),
+            }
+
+
+class ShardResultCache:
+    """Replica-side exact result cache for the ``/shard/*`` surface
+    (``oryx.cluster.replica-cache.*``, off by default).
+
+    The router's result cache saves the scatter; this one saves the
+    DEVICE: a cold-router miss on a shard query the replica already
+    answered (a restarted router, a second router in the same region,
+    a cache-busted public request that maps to the same internal
+    query) skips scoring entirely.  Same epoch discipline as the
+    router cache, one level stricter: the epoch is a counter bumped on
+    EVERY model-state record this replica applies (UP fold-ins and
+    MODEL/MODEL-REF publishes alike — the serving layer's update tap
+    feeds :meth:`note_record`), so an entry serves only while nothing
+    whatsoever has changed in the model it was computed from.  Exact
+    by construction, no per-tag index needed.
+
+    The bump happens when the record is HANDED to the model manager,
+    a beat before the apply completes; like the router cache's
+    invalidation quarantine, stores are refused for a configured
+    window after the last bump so an answer computed from mid-apply
+    state can never be retained under the post-apply epoch.
+
+    Entries hold the COMPLETE rendered answer (status + response
+    headers + body bytes) keyed by ``(method, path, body)``: a hit
+    replays the exact bytes the frame dispatcher produced for the
+    first asker, byte-identical by construction.  Bounded LRU with a
+    byte budget, same shape as the router cache's.
+    """
+
+    def __init__(self, config, metrics=None, clock=None):
+        c = "oryx.cluster.replica-cache"
+        self.enabled = config.get_bool(f"{c}.enabled")
+        self.max_entries = config.get_int(f"{c}.max-entries")
+        self.max_bytes = config.get_int(f"{c}.max-bytes")
+        self.quarantine_sec = \
+            config.get_int(f"{c}.quarantine-ms") / 1000.0
+        if self.max_entries < 1 or self.max_bytes < 1:
+            raise ValueError(
+                "oryx.cluster.replica-cache budgets must be >= 1")
+        self._metrics = metrics
+        self._clock = clock or _monotonic
+        self._lock = threading.Lock()
+        # (method, path, body) -> (epoch, status, headers, body, bytes)
+        self._entries: OrderedDict[tuple, tuple] = OrderedDict()
+        self._bytes = 0
+        self._epoch = 0
+        self._last_bump = -1e9
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.store_rejects = 0
+
+    @classmethod
+    def from_config(cls, config, metrics=None) -> "ShardResultCache | None":
+        cache = cls(config, metrics)
+        return cache if cache.enabled else None
+
+    # -- epoch feed ----------------------------------------------------------
+
+    def note_record(self) -> None:
+        """One model-state record (UP / MODEL / MODEL-REF) is about to
+        be applied: move the epoch.  Every cached entry is keyed under
+        the previous epoch and stops serving instantly; their bytes
+        are reclaimed lazily as lookups touch them and by LRU
+        pressure."""
+        with self._lock:
+            self._epoch += 1
+            self._last_bump = self._clock()
+
+    def tap(self, stream):
+        """Wrap the serving layer's (heartbeat-filtered) update replay:
+        the epoch moves on BOTH sides of every record's apply.  The
+        pre-yield bump fences new lookups off entries computed from
+        the pre-apply model; the post-yield bump (which runs when the
+        consumer asks for the NEXT record — i.e. the moment this
+        record's apply completed) retires anything a mid-apply request
+        managed to store under the in-between epoch.  Together they
+        make the stale-store window zero REGARDLESS of how long the
+        apply takes (a sliced MODEL-REF load can run for seconds —
+        far past any fixed quarantine); the quarantine remains as
+        defense in depth for clock-adjacent races."""
+        for km in stream:
+            self.note_record()
+            yield km
+            self.note_record()
+
+    def epoch(self) -> int:
+        with self._lock:
+            return self._epoch
+
+    # -- lookup / store ------------------------------------------------------
+
+    def lookup(self, method: str, path: str, body: bytes
+               ) -> "tuple[int, dict, bytes] | None":
+        """(status, response headers, body bytes) when the exact query
+        was answered under the CURRENT epoch; None (counted as a miss)
+        otherwise.  A stale-epoch entry is dropped on touch."""
+        key = (method, path, body)
+        with self._lock:
+            got = self._entries.get(key)
+            if got is not None and got[0] == self._epoch:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                if self._metrics is not None:
+                    self._metrics.inc("shard_cache_hits")
+                return got[1], got[2], got[3]
+            if got is not None:
+                # keyed under a retired epoch: unservable, reclaim now
+                del self._entries[key]
+                self._bytes -= got[4]
+            self.misses += 1
+            if self._metrics is not None:
+                self._metrics.inc("shard_cache_misses")
+            return None
+
+    def store(self, method: str, path: str, body: bytes,
+              epoch0: int, status: int, headers: dict,
+              payload: bytes) -> None:
+        """Offer a finished answer computed while the epoch was
+        ``epoch0``.  Refused for non-200s, when the epoch moved during
+        the request, or within the quarantine window after the last
+        bump (the answer may have read mid-apply state)."""
+        if not self.enabled or status != 200:
+            return
+        size = len(payload) + len(path) + len(body) + 160
+        with self._lock:
+            if self._epoch != epoch0 \
+                    or self._clock() - self._last_bump \
+                    < self.quarantine_sec:
+                self.store_rejects += 1
+                return
+            key = (method, path, body)
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old[4]
+            self._entries[key] = (epoch0, status, dict(headers),
+                                  payload, size)
+            self._bytes += size
+            while self._entries and (
+                    len(self._entries) > self.max_entries
+                    or self._bytes > self.max_bytes):
+                _, dropped = self._entries.popitem(last=False)
+                self._bytes -= dropped[4]
+                self.evictions += 1
+
+    def flush(self) -> int:
+        with self._lock:
+            n = len(self._entries)
+            self._entries.clear()
+            self._bytes = 0
+        return n
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "entries": len(self._entries),
+                "bytes": self._bytes,
+                "epoch": self._epoch,
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "store_rejects": self.store_rejects,
             }

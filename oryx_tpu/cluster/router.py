@@ -31,6 +31,7 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import time
 import urllib.parse
 from typing import Sequence
 
@@ -882,6 +883,14 @@ class RouterLayer:
         self._consume_thread: threading.Thread | None = None
         self._server = None
         self._server_thread: threading.Thread | None = None
+        # C10K front end (cluster/async_http.py): an asyncio event
+        # loop replaces thread-per-connection when enabled — cache
+        # hits and coalesced followers never leave the loop, misses
+        # bridge to a fixed worker pool, and concurrency is bounded by
+        # file descriptors instead of thread stacks
+        self.async_enabled = config.get_bool(
+            "oryx.cluster.async.enabled")
+        self._frontend = None
         self.app = HttpApp(
             ROUTES,
             context={
@@ -988,22 +997,49 @@ class RouterLayer:
             ssl_context = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
             ssl_context.load_cert_chain(self.keystore_file,
                                         password=self.keystore_password)
-        self._server = make_server(self.app, self.port,
-                                   ssl_context=ssl_context)
-        self.port = self._server.server_address[1]
         self.scheme = "https" if ssl_context is not None else "http"
-        self._server_thread = threading.Thread(
-            target=self._server.serve_forever, daemon=True,
-            name="RouterHTTP")
-        self._server_thread.start()
-        _log.info("Router listening on port %d", self.port)
+        if self.async_enabled:
+            from .async_http import AsyncFrontEnd
+            self._frontend = AsyncFrontEnd(self.app, self.port,
+                                           self.config,
+                                           ssl_context=ssl_context)
+            self._frontend.start()
+            self.port = self._frontend.port
+            fe = self._frontend
+            self.metrics.gauge_fn(
+                "async_open_connections",
+                lambda: float(fe.open_connections))
+            self.metrics.gauge_fn("async_loop_lag_ms",
+                                  lambda: float(fe.loop_lag_ms))
+            _log.info("Router (async front end) listening on port %d",
+                      self.port)
+        else:
+            self._server = make_server(self.app, self.port,
+                                       ssl_context=ssl_context)
+            self.port = self._server.server_address[1]
+            self._server_thread = threading.Thread(
+                target=self._server.serve_forever, daemon=True,
+                name="RouterHTTP")
+            self._server_thread.start()
+            _log.info("Router listening on port %d", self.port)
+        if self.scatter.transport is not None:
+            sg = self.scatter
+            self.metrics.gauge_fn(
+                "transport_open_connections",
+                lambda: float(sg.transport.open_connections()))
 
     def await_(self) -> None:
+        if self._frontend is not None:
+            while self._frontend.is_alive():
+                time.sleep(1.0)
+            return
         while self._server_thread and self._server_thread.is_alive():
             self._server_thread.join(1.0)
 
     def close(self) -> None:
         self._stop.set()
+        if self._frontend is not None:
+            self._frontend.shutdown()
         if self._server:
             self._server.shutdown()
         self.scatter.close()

@@ -55,6 +55,7 @@ from ..api.serving import OryxServingException
 from ..resilience import faults
 from ..resilience.policy import CircuitBreaker, CircuitOpenError, Deadline
 from .membership import Heartbeat, MembershipRegistry
+from .transport import FrameTransport, StreamAbandoned
 
 _log = logging.getLogger(__name__)
 
@@ -91,21 +92,50 @@ class _Pool:
     TLS without certificate verification: the scatter plane rides the
     cluster-internal network against the replicas' own (typically
     self-signed) serving certs, the same trust model the repo's TLS
-    tests use client-side."""
+    tests use client-side.
 
-    def __init__(self, connect_timeout: float = 5.0):
-        self._conns: dict[str, list[tuple[socket.socket, object]]] = {}
+    Hygiene (``oryx.cluster.pool.*``): idle sockets age out after
+    ``idle_ttl_sec`` and each URL's stack is bounded at
+    ``max_per_url`` — with autoscaled replicas on ephemeral ports
+    every spawn/retire cycle adds a URL, and an unbounded pool would
+    pin dead sockets (and map entries) forever.  The sweep runs
+    opportunistically on release, so an idle router still converges:
+    its next request (or the periodic scrape) reclaims the lot."""
+
+    def __init__(self, connect_timeout: float = 5.0,
+                 idle_ttl_sec: float = 30.0, max_per_url: int = 64):
+        # url -> [(socket, rfile, released_at_monotonic), ...]
+        self._conns: dict[str, list[tuple]] = {}
         self._lock = threading.Lock()
         self.connect_timeout = connect_timeout
+        self.idle_ttl_sec = idle_ttl_sec
+        self.max_per_url = max(1, max_per_url)
         self._tls = None
+        self._last_sweep = time.monotonic()
+        self.idle_evictions = 0
+        self.cap_evictions = 0
 
     def acquire(self, url: str) -> tuple[tuple[socket.socket, object], bool]:
         """(connection, reused) — ``reused`` means keep-alive from the
-        pool, which may have died since its last request."""
-        with self._lock:
-            stack = self._conns.get(url)
-            if stack:
-                return stack.pop(), True
+        pool, which may have died since its last request.  Entries
+        idle past the TTL are discarded on the way out: a socket that
+        sat unused that long has likely been dropped by the far end
+        (or a middlebox), and handing it out just buys a stale-socket
+        retry."""
+        now = time.monotonic()
+        stale = []
+        try:
+            with self._lock:
+                stack = self._conns.get(url)
+                while stack:
+                    conn, rfile, released = stack.pop()
+                    if now - released <= self.idle_ttl_sec:
+                        return (conn, rfile), True
+                    stale.append((conn, rfile))
+                    self.idle_evictions += 1
+        finally:
+            for conn_rf in stale:
+                self.discard(conn_rf)
         return self.fresh(url), False
 
     def fresh(self, url: str) -> tuple[socket.socket, object]:
@@ -124,10 +154,63 @@ class _Pool:
         return conn, conn.makefile("rb")
 
     def release(self, url: str, conn_rf) -> None:
+        dropped = []
         with self._lock:
-            self._conns.setdefault(url, []).append(conn_rf)
+            stack = self._conns.setdefault(url, [])
+            stack.append((conn_rf[0], conn_rf[1], time.monotonic()))
+            while len(stack) > self.max_per_url:
+                # oldest-idle first: the bound sheds the sockets least
+                # likely to be reused
+                dropped.append(stack.pop(0))
+                self.cap_evictions += 1
+        for conn, rfile, _ in dropped:
+            self.discard((conn, rfile))
+        self._sweep()
+
+    def _sweep(self) -> None:
+        """Reclaim idle-past-TTL sockets across EVERY url and drop
+        empty url keys — the long-gone-replica path: once its sockets
+        age out nothing references the URL again."""
+        now = time.monotonic()
+        stale = []
+        with self._lock:
+            if now - self._last_sweep < max(1.0, self.idle_ttl_sec / 4):
+                return
+            self._last_sweep = now
+            for url in list(self._conns):
+                stack = self._conns[url]
+                keep = []
+                for entry in stack:
+                    if now - entry[2] <= self.idle_ttl_sec:
+                        keep.append(entry)
+                    else:
+                        stale.append(entry)
+                        self.idle_evictions += 1
+                if keep:
+                    self._conns[url] = keep
+                else:
+                    del self._conns[url]
+        for conn, rfile, _ in stale:
+            self.discard((conn, rfile))
+
+    def pooled(self, url: str | None = None) -> int:
+        """Pooled-socket count (per url, or total) — test/metrics
+        introspection."""
+        with self._lock:
+            if url is not None:
+                return len(self._conns.get(url, ()))
+            return sum(len(s) for s in self._conns.values())
 
     def discard(self, conn_rf) -> None:
+        # shutdown BEFORE close: a hedge-cancel closer runs on the
+        # winner's thread while the loser is blocked in recv on this
+        # socket — close() alone does not reliably wake a concurrent
+        # reader; shutdown() does (the read returns EOF/ECONNRESET
+        # and the loser exits through the abandoned path)
+        try:
+            conn_rf[0].shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             conn_rf[0].close()
         except OSError:
@@ -139,14 +222,14 @@ class _Pool:
         certainly are too."""
         with self._lock:
             stack = self._conns.pop(url, [])
-        for conn_rf in stack:
-            self.discard(conn_rf)
+        for conn, rfile, _ in stack:
+            self.discard((conn, rfile))
 
     def close(self) -> None:
         with self._lock:
             for stack in self._conns.values():
-                for conn_rf in stack:
-                    self.discard(conn_rf)
+                for conn, rfile, _ in stack:
+                    self.discard((conn, rfile))
             self._conns.clear()
 
 
@@ -186,6 +269,63 @@ def _request(conn, rfile, method: str, path: str, body: bytes | None,
             raise ConnectionError("short body from replica")
         out += got
     return status, out, rhdrs
+
+
+class _CancelToken:
+    """One hedged shard query's cancellation latch.  Each in-flight
+    attempt registers a closer (close the HTTP socket / CANCEL the
+    frame stream); when a sibling wins — or the query gives up — the
+    token fires every registered closer, so the losers are torn down
+    NOW instead of finishing reads nobody will consume and returning
+    possibly-stalled sockets to the keep-alive pool."""
+
+    __slots__ = ("_lock", "_closers", "_next", "fired")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._closers: dict[int, object] = {}
+        self._next = 0
+        self.fired = False
+
+    def register(self, closer) -> int | None:
+        """None when the token already fired (the race is over before
+        this attempt got started)."""
+        with self._lock:
+            if self.fired:
+                return None
+            self._next += 1
+            self._closers[self._next] = closer
+            return self._next
+
+    def update(self, key: int, closer) -> bool:
+        with self._lock:
+            if self.fired:
+                return False
+            self._closers[key] = closer
+            return True
+
+    def unregister(self, key: int) -> None:
+        with self._lock:
+            self._closers.pop(key, None)
+
+    def fire(self) -> None:
+        with self._lock:
+            if self.fired:
+                return
+            self.fired = True
+            closers = list(self._closers.values())
+            self._closers.clear()
+        for fn in closers:
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — teardown is best-effort
+                pass
+
+
+# sentinel threaded through the breaker for a cancelled loser: a
+# normal return, so the breaker never counts failure evidence against
+# a replica that was merely slower than its hedge sibling
+_ABANDONED = object()
 
 
 class _DigestAuth:
@@ -257,7 +397,17 @@ class ScatterGather:
             config.get_int(f"{c}.shard-timeout-ms") / 1000.0
         self.max_attempts = config.get_int(f"{c}.max-attempts-per-shard")
         self._config = config
-        self._pool = _Pool()
+        self._pool = _Pool(
+            idle_ttl_sec=config.get_int(
+                f"{c}.pool.idle-ttl-ms") / 1000.0,
+            max_per_url=config.get_int(f"{c}.pool.max-per-url"))
+        # multiplexed framed transport (cluster/transport.py): when
+        # enabled, attempts against replicas that advertise a
+        # transport port ride one persistent framed connection per
+        # replica; the HTTP/1.1 pool stays the fallback for replicas
+        # that don't (mixed-fleet rollout)
+        self.transport = FrameTransport(config) \
+            if config.get_bool(f"{c}.transport.enabled") else None
         user = config.get_optional_string("oryx.serving.api.user-name")
         self._auth = _DigestAuth(
             user, config.get_optional_string("oryx.serving.api.password")
@@ -271,6 +421,9 @@ class ScatterGather:
         self.shard_failures = 0
         self.partial_answers = 0
         self.group_failovers = 0
+        # hedged losers torn down mid-flight instead of finishing
+        # reads nobody consumes (and poisoning the keep-alive pool)
+        self.hedge_abandoned = 0
         # replica url -> (reported scoring queue-wait ms, seen
         # monotonic): piggybacked on every shard envelope, the live
         # overload signal the router's admission control reads
@@ -326,6 +479,8 @@ class ScatterGather:
     def close(self) -> None:
         self._exec.shutdown(wait=False)
         self._pool.close()
+        if self.transport is not None:
+            self.transport.close()
 
     def _breaker(self, url: str) -> CircuitBreaker:
         with self._lock:
@@ -340,7 +495,7 @@ class ScatterGather:
 
     def _attempt(self, hb: Heartbeat, shard: int, method: str, path: str,
                  body: bytes | None, deadline: Deadline | None,
-                 traceparent: str | None = None):
+                 traceparent: str | None = None, cancel=None):
         timeout = self.shard_timeout_sec
         headers = {}
         if traceparent:
@@ -354,77 +509,177 @@ class ScatterGather:
             # router would no longer wait for
             headers["X-Deadline-Ms"] = str(max(1, int(remaining * 1000)))
 
+        if self.transport is not None and getattr(hb, "tport", None):
+            # the multiplexed framed hop: one persistent connection
+            # per replica, this attempt is one more interleaved stream
+            # on it (auth is the connection-level AUTH frame)
+            out = self._breaker(hb.url).call(
+                self._framed_call, hb, shard, method, path, body,
+                headers, timeout, traceparent, cancel)
+            if out is _ABANDONED:
+                raise StreamAbandoned(f"hedge abandoned for {hb.url}")
+            return out
+
         if self._auth is not None:
             h = self._auth.header(hb.url, method, path)
             if h:
                 headers["Authorization"] = h
 
+        # the closer a firing cancel token runs: close THE CURRENT
+        # in-flight socket so the loser's blocked read dies now —
+        # holder[0] tracks it across the stale-socket retry, and is
+        # cleared before release so a pooled socket is never closed
+        holder = [None]
+
+        def close_inflight():
+            conn_rf = holder[0]
+            if conn_rf is not None:
+                self._pool.discard(conn_rf)
+
         def call():
             conn_rf, reused = self._pool.acquire(hb.url)
+            holder[0] = conn_rf
+            ckey = None
+            if cancel is not None:
+                ckey = cancel.register(close_inflight)
+                if ckey is None:
+                    # the race was over before this attempt started
+                    holder[0] = None
+                    self._pool.release(hb.url, conn_rf)
+                    return self._abandon()
             try:
-                status, raw, rhdrs = _request(conn_rf[0], conn_rf[1],
-                                              method, path, body,
-                                              headers, timeout)
-            except ConnectionError:
-                # a reused keep-alive socket died between requests (the
-                # replica restarted — a designed, supervised event): that
-                # is a property of THIS socket, not of the replica, so
-                # retry once on a fresh connection before letting the
-                # failure count against the breaker.  Internal queries
-                # are all idempotent reads.  Timeouts deliberately do
-                # NOT retry (a slow replica must cost one window, not
-                # two).
-                self._pool.discard(conn_rf)
-                if not reused:
-                    raise
-                self._pool.purge(hb.url)
-                conn_rf = self._pool.fresh(hb.url)
                 try:
                     status, raw, rhdrs = _request(conn_rf[0], conn_rf[1],
                                                   method, path, body,
                                                   headers, timeout)
+                except ConnectionError:
+                    if cancel is not None and cancel.fired:
+                        self._pool.discard(conn_rf)
+                        return self._abandon()
+                    # a reused keep-alive socket died between requests
+                    # (the replica restarted — a designed, supervised
+                    # event): that is a property of THIS socket, not of
+                    # the replica, so retry once on a fresh connection
+                    # before letting the failure count against the
+                    # breaker.  Internal queries are all idempotent
+                    # reads.  Timeouts deliberately do NOT retry (a
+                    # slow replica must cost one window, not two).
+                    self._pool.discard(conn_rf)
+                    if not reused:
+                        raise
+                    self._pool.purge(hb.url)
+                    conn_rf = self._pool.fresh(hb.url)
+                    holder[0] = conn_rf
+                    if cancel is not None and cancel.fired:
+                        self._pool.discard(conn_rf)
+                        return self._abandon()
+                    try:
+                        status, raw, rhdrs = _request(conn_rf[0],
+                                                      conn_rf[1],
+                                                      method, path, body,
+                                                      headers, timeout)
+                    except BaseException:
+                        self._pool.discard(conn_rf)
+                        if cancel is not None and cancel.fired:
+                            return self._abandon()
+                        raise
                 except BaseException:
                     self._pool.discard(conn_rf)
+                    if cancel is not None and cancel.fired:
+                        return self._abandon()
                     raise
-            except BaseException:
+                if status == 401 and self._auth is not None and \
+                        self._auth.challenge(
+                            hb.url, rhdrs.get("www-authenticate", "")):
+                    # first contact, or the replica rotated its nonce
+                    # set: answer the fresh challenge once on the same
+                    # keep-alive connection (the 401 carries
+                    # Content-Length: 0)
+                    headers["Authorization"] = self._auth.header(
+                        hb.url, method, path)
+                    try:
+                        status, raw, rhdrs = _request(conn_rf[0],
+                                                      conn_rf[1],
+                                                      method, path, body,
+                                                      headers, timeout)
+                    except BaseException:
+                        self._pool.discard(conn_rf)
+                        if cancel is not None and cancel.fired:
+                            return self._abandon()
+                        raise
+            finally:
+                if ckey is not None:
+                    cancel.unregister(ckey)
+            holder[0] = None
+            if cancel is not None and cancel.fired:
+                # won race landed between the read and here: the
+                # socket's state is unknowable (the closer may have
+                # fired mid-release) — never pool it
                 self._pool.discard(conn_rf)
-                raise
-            if status == 401 and self._auth is not None and \
-                    self._auth.challenge(
-                        hb.url, rhdrs.get("www-authenticate", "")):
-                # first contact, or the replica rotated its nonce set:
-                # answer the fresh challenge once on the same keep-alive
-                # connection (the 401 carries Content-Length: 0)
-                headers["Authorization"] = self._auth.header(
-                    hb.url, method, path)
-                try:
-                    status, raw, rhdrs = _request(conn_rf[0], conn_rf[1],
-                                                  method, path, body,
-                                                  headers, timeout)
-                except BaseException:
-                    self._pool.discard(conn_rf)
-                    raise
-            self._pool.release(hb.url, conn_rf)
-            payload = None
-            if raw:
-                try:
-                    payload = json.loads(raw)
-                except ValueError:
-                    payload = {"error": raw[:512].decode("latin-1")}
-            if isinstance(payload, dict) \
-                    and "queue_wait_ms" in payload:
-                try:
-                    self.note_queue_wait(hb.url,
-                                         float(payload["queue_wait_ms"]))
-                except (TypeError, ValueError):
-                    pass  # malformed envelope field: not load-bearing
-            if status >= 500:
-                # replica answered but is unhealthy (lost its model,
-                # internal error): failover like a transport fault
-                raise ConnectionError(f"replica {hb.url} -> {status}")
-            return ShardResponse(shard, status, payload, hb.url)
+            else:
+                self._pool.release(hb.url, conn_rf)
+            return self._finish_attempt(hb, shard, status, raw)
 
-        return self._breaker(hb.url).call(call)
+        out = self._breaker(hb.url).call(call)
+        if out is _ABANDONED:
+            raise StreamAbandoned(f"hedge abandoned for {hb.url}")
+        return out
+
+    def _abandon(self):
+        with self._lock:
+            self.hedge_abandoned += 1
+        return _ABANDONED
+
+    def _framed_call(self, hb, shard, method, path, body, headers,
+                     timeout, traceparent, cancel):
+        t0 = time.monotonic()
+        try:
+            status, raw, _ = self.transport.request(
+                hb, method, path, body, headers, timeout, cancel=cancel)
+        except StreamAbandoned:
+            return self._abandon()
+        self._record_frame_span(traceparent, t0, time.monotonic(),
+                                hb, shard, status)
+        return self._finish_attempt(hb, shard, status, raw)
+
+    def _record_frame_span(self, tp, t0, t1, hb, shard, status) -> None:
+        """Retroactive ``transport.frame_call`` span under the sampled
+        request's shard_call — the framed hop's wire time, named so a
+        slow frame is attributable separately from replica compute."""
+        if self.tracer is None or not tp:
+            return
+        from ..obs.trace import parse_traceparent
+        ctx = parse_traceparent(tp)
+        if not ctx or not ctx[2]:
+            return
+        self.tracer.record_span(
+            "transport.frame_call", (ctx[0], ctx[1]), t0, t1,
+            attrs={"replica": hb.url, "shard": shard,
+                   "http.status": status})
+
+    def _finish_attempt(self, hb, shard: int, status: int,
+                        raw: bytes) -> ShardResponse:
+        """Shared attempt epilogue for both transports: parse the JSON
+        envelope, harvest the queue-wait piggyback, and fail over on
+        5xx exactly like a transport fault."""
+        payload = None
+        if raw:
+            try:
+                payload = json.loads(raw)
+            except ValueError:
+                payload = {"error": raw[:512].decode("latin-1")}
+        if isinstance(payload, dict) \
+                and "queue_wait_ms" in payload:
+            try:
+                self.note_queue_wait(hb.url,
+                                     float(payload["queue_wait_ms"]))
+            except (TypeError, ValueError):
+                pass  # malformed envelope field: not load-bearing
+        if status >= 500:
+            # replica answered but is unhealthy (lost its model,
+            # internal error): failover like a transport fault
+            raise ConnectionError(f"replica {hb.url} -> {status}")
+        return ShardResponse(shard, status, payload, hb.url)
 
     # -- hedged per-shard query ---------------------------------------------
 
@@ -503,12 +758,19 @@ class ScatterGather:
         box: SimpleQueue = SimpleQueue()
         errors: list[BaseException] = []
         in_flight = 0
+        # hedge cancellation: the moment one attempt wins (or the
+        # query gives up), every other in-flight attempt is torn down
+        # — a socket close on the HTTP hop, a CANCEL frame on the
+        # framed hop — so a stalled replica can't poison the
+        # keep-alive pool with a mid-response socket and never
+        # computes an answer nobody is waiting for
+        cancel = _CancelToken()
 
         def attempt_async(hb: Heartbeat) -> None:
             def run():
                 try:
                     box.put(self._attempt(hb, shard, method, path, body,
-                                          deadline, tp))
+                                          deadline, tp, cancel=cancel))
                 except BaseException as e:  # noqa: BLE001 — collected
                     box.put(e)
             threading.Thread(target=run, daemon=True,
@@ -567,7 +829,10 @@ class ScatterGather:
                         self.group_failovers += 1
                 return res
         finally:
-            pass
+            # win or give-up: the losers are cancelled NOW (counted
+            # in hedge_abandoned), never left to finish reads nobody
+            # consumes
+            cancel.fire()
         with self._lock:
             self.shard_failures += 1
         detail = "; ".join(f"{type(e).__name__}: {e}" for e in errors[-3:])
@@ -697,9 +962,21 @@ class ScatterGather:
     def stats(self) -> dict:
         qw = self.cluster_queue_wait_ms()
         with self._lock:
-            return {"hedges": self.hedges,
-                    "shard_failures": self.shard_failures,
-                    "partial_answers": self.partial_answers,
-                    "group_failovers": self.group_failovers,
-                    "cluster_queue_wait_ms":
-                        None if qw is None else round(qw, 2)}
+            out = {"hedges": self.hedges,
+                   "shard_failures": self.shard_failures,
+                   "partial_answers": self.partial_answers,
+                   "group_failovers": self.group_failovers,
+                   "hedge_abandoned": self.hedge_abandoned,
+                   "cluster_queue_wait_ms":
+                       None if qw is None else round(qw, 2),
+                   "pool": {"sockets": self._pool.pooled(),
+                            "idle_evictions": self._pool.idle_evictions,
+                            "cap_evictions": self._pool.cap_evictions}}
+        if self.transport is not None:
+            out["transport"] = {
+                "open_connections": self.transport.open_connections(),
+                "per_replica": self.transport.connection_snapshot(),
+                "cancels_sent": self.transport.cancels_sent,
+                "reconnects": self.transport.reconnects,
+            }
+        return out

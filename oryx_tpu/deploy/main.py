@@ -97,9 +97,16 @@ def _cmd_serving(args) -> int:
 
 def _cmd_router(args) -> int:
     """The scatter-gather gateway: public REST front end over a fleet
-    of shard replicas (cluster/router.py)."""
+    of shard replicas (cluster/router.py).  ``--async``/``--no-async``
+    overrides ``oryx.cluster.async.enabled`` (the C10K event-loop
+    front end vs the threaded fallback) without editing the conf."""
     from ..cluster.router import RouterLayer
     config = _load_config(args.conf)
+    if getattr(args, "async_mode", None) is not None:
+        from ..common.config import from_dict
+        config = from_dict(
+            {"oryx.cluster.async.enabled": bool(args.async_mode)},
+            config)
     _run_layer(lambda: RouterLayer(config), "router", config)
     return 0
 
@@ -287,6 +294,16 @@ def main(argv: list[str] | None = None) -> int:
         p = sub.add_parser(name, help=help_)
         p.add_argument("--conf", help="HOCON config file overlaying defaults")
         p.set_defaults(fn=fn)
+        if name == "router":
+            p.add_argument("--async", dest="async_mode",
+                           action=argparse.BooleanOptionalAction,
+                           default=None,
+                           help="serve the public door on the asyncio "
+                                "event-loop front end (connection "
+                                "ceiling in sockets, not threads); "
+                                "--no-async forces the threaded "
+                                "server.  Default: "
+                                "oryx.cluster.async.enabled")
         if name == "serving":
             p.add_argument("--shard", default=None, metavar="i/N",
                            help="serve catalog shard i of N as a "

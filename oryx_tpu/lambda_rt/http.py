@@ -275,6 +275,12 @@ class HttpApp:
     def _auth_ok(self, handler: BaseHTTPRequestHandler) -> bool:
         if self.user_name is None:
             return True
+        if getattr(handler, "_oryx_preauth", False):
+            # the framed-transport dispatcher authenticated its whole
+            # connection up front (AUTH frame carrying the DIGEST HA1,
+            # cluster/transport.py) — per-request challenges would buy
+            # nothing on a connection that already proved the secret
+            return True
         auth = handler.headers.get("Authorization", "")
         if not auth.startswith("Digest "):
             return False

@@ -75,6 +75,12 @@ class ServingLayer:
         # the gateway routes to it
         self.cluster_enabled = config.get_bool("oryx.cluster.enabled")
         self.heartbeat: HeartbeatPublisher | None = None
+        # framed internal transport (cluster/transport.py): a frame
+        # listener next to the HTTP door, its port advertised in the
+        # heartbeat; and the replica-side result cache the frame
+        # dispatcher consults before touching the device
+        self._frame_server = None
+        self._shard_cache = None
 
         manager_class = config.get_string("oryx.serving.model-manager-class")
         self.model_manager = load_instance(manager_class, config)
@@ -114,6 +120,16 @@ class ServingLayer:
             idle_wait_s=None if idle_ms < 0 else idle_ms / 1000.0,
             tracer=self.tracer)
         self.metrics = MetricsRegistry()
+        if self.cluster_enabled:
+            # replica-side exact result cache for /shard/* answers
+            # (cluster/result_cache.py ShardResultCache; off by
+            # default): consulted by the frame dispatcher so a
+            # repeated shard query under an unchanged model epoch
+            # skips the device — the update replay's tap moves the
+            # epoch per applied record
+            from ..cluster.result_cache import ShardResultCache
+            self._shard_cache = ShardResultCache.from_config(
+                config, self.metrics)
         # freshness surface: update-consumer lag + model generation age
         # from a passive tap on the replay (obs/freshness.py)
         self._update_tap = freshness.UpdateStreamTap()
@@ -224,9 +240,22 @@ class ServingLayer:
         self._server_thread.start()
         _log.info("Serving layer listening on port %d", self.port)
         if self.cluster_enabled and self.update_broker and self.update_topic:
+            c = "oryx.cluster"
+            tport = None
+            if self.config.get_bool(f"{c}.transport.enabled"):
+                # the framed scatter listener rides next to the HTTP
+                # door; its port travels in the heartbeat so the
+                # router multiplexes one connection here instead of a
+                # socket pool (cluster/transport.py)
+                from ..cluster.transport import FrameServer
+                self._frame_server = FrameServer(
+                    self.app, self.config, metrics=self.metrics,
+                    shard_cache=self._shard_cache)
+                self._frame_server.start()
+                tport = self._frame_server.port
+                _log.info("Frame transport listening on port %d", tport)
             # announce this replica AFTER the port is bound (the
             # heartbeat carries the live URL)
-            c = "oryx.cluster"
             shard, of = parse_shard_spec(
                 self.config.get_optional_string(f"{c}.shard") or "0/1")
             host = self.config.get_string(f"{c}.advertise-host")
@@ -241,7 +270,8 @@ class ServingLayer:
                 replica_id=self.config.get_optional_string(
                     f"{c}.replica-id"),
                 region=self.config.get_optional_string(
-                    f"{c}.region.name"))
+                    f"{c}.region.name"),
+                tport=tport)
             self.heartbeat.start()
 
     @staticmethod
@@ -262,15 +292,26 @@ class ServingLayer:
         # (reference: auto.offset.reset=smallest), so the serving model
         # converges to the same state either way
         broker = resolve_broker(self.update_broker)
+
         # cluster heartbeats share the update topic; they are control
         # plane, not model state, and are filtered before the manager
         # the freshness tap counts RAW records (heartbeats included) so
         # its count compares against the topic head's raw offsets
-        run_with_resubscribe(
-            lambda: self.model_manager.consume(without_heartbeats(
+        def stream():
+            s = without_heartbeats(
                 self._replay_stall_seam(self._update_tap.wrap(
-                    broker.consume(self.update_topic, from_beginning=True,
-                                   stop=self._stop))))),
+                    broker.consume(self.update_topic,
+                                   from_beginning=True,
+                                   stop=self._stop))))
+            if self._shard_cache is not None:
+                # the replica cache's epoch feed: every model-state
+                # record (heartbeats already filtered) moves the epoch
+                # BEFORE the manager applies it
+                s = self._shard_cache.tap(s)
+            return s
+
+        run_with_resubscribe(
+            lambda: self.model_manager.consume(stream()),
             stop=self._stop, what="serving update consumer", log=_log)
 
     def await_(self) -> None:
@@ -281,6 +322,8 @@ class ServingLayer:
         self._stop.set()
         if self.heartbeat is not None:
             self.heartbeat.close()
+        if self._frame_server is not None:
+            self._frame_server.close()
         if self._server:
             self._server.shutdown()
         self.top_n_batcher.close()

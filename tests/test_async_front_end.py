@@ -1,0 +1,484 @@
+"""C10K async front end integration tests (ISSUE 12 acceptance): the
+event-loop router against a real 2-shard cluster, proving
+
+1. byte-identity: the async front end serves the full cacheable
+   surface byte-identical to the THREADED cached router and to an
+   uncached cold router — misses (bridged), hits (on-loop), CSV and
+   gzip variants, negative 404s (the test_cache_it oracle pattern);
+2. connection scale: >= 1k concurrent keep-alive connections all
+   answer 200 on the cache-hit workload while the PROCESS THREAD
+   COUNT stays flat (the concurrency ceiling is sockets, not
+   threads);
+3. graceful behavior at the connection cap: one fast 503 and a
+   close, never a hang;
+4. the ``async-loop-block`` chaos point: a handler that blocks the
+   loop is seen by the watchdog (counter + slow-loop log);
+5. coalescing on-loop: a burst of identical requests collapses onto
+   one scatter with every response byte-identical.
+
+Marker: chaos (in the tier-1 budget).
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from oryx_tpu.cluster.router import RouterLayer
+from oryx_tpu.common.config import from_dict
+from oryx_tpu.kafka.inproc import get_broker
+from oryx_tpu.lambda_rt.serving import ServingLayer
+from oryx_tpu.resilience import faults
+from oryx_tpu.resilience.policy import Deadline
+
+pytestmark = pytest.mark.chaos
+
+BROKER = "async-it"
+UPDATE_TOPIC = "AUp"
+FEATURES = 3
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _config(**extra):
+    overlay = {
+        "oryx.id": "async-it",
+        "oryx.input-topic.broker": f"memory://{BROKER}",
+        "oryx.input-topic.partitions": 1,
+        "oryx.input-topic.message.topic": "AIn",
+        "oryx.update-topic.broker": f"memory://{BROKER}",
+        "oryx.update-topic.message.topic": UPDATE_TOPIC,
+        "oryx.serving.model-manager-class":
+            "oryx_tpu.app.als.serving_manager.ALSServingModelManager",
+        "oryx.serving.application-resources": "oryx_tpu.serving.als",
+        "oryx.als.implicit": True,
+        "oryx.als.hyperparams.features": FEATURES,
+        "oryx.cluster.heartbeat-interval-ms": 60,
+        "oryx.cluster.heartbeat-ttl-ms": 400,
+        "oryx.cluster.hedge-after-ms": 50,
+        "oryx.cluster.shard-timeout-ms": 5000,
+        "oryx.resilience.retry.max-attempts": 2,
+        "oryx.resilience.retry.initial-backoff-ms": 1,
+        "oryx.resilience.retry.max-backoff-ms": 2,
+    }
+    overlay.update(extra)
+    return from_dict(overlay)
+
+
+def _cached_overlay(**extra):
+    overlay = {"oryx.cluster.cache.enabled": True,
+               "oryx.cluster.coalesce.enabled": True}
+    overlay.update(extra)
+    return overlay
+
+
+def _publish_model(broker, n_users=6, n_items=14, seed=29):
+    from oryx_tpu.common import pmml as pmml_io
+    from oryx_tpu.kafka.api import KEY_MODEL, KEY_UP
+    users = [f"au{j}" for j in range(n_users)]
+    items = [f"ai{j}" for j in range(n_items)]
+    doc = pmml_io.build_skeleton_pmml()
+    pmml_io.add_extension(doc, "features", FEATURES)
+    pmml_io.add_extension(doc, "implicit", True)
+    pmml_io.add_extension_content(doc, "XIDs", users)
+    pmml_io.add_extension_content(doc, "YIDs", items)
+    broker.send(UPDATE_TOPIC, KEY_MODEL, pmml_io.to_string(doc))
+    rng = np.random.default_rng(seed)
+    for iid in items:
+        broker.send(UPDATE_TOPIC, KEY_UP, json.dumps(
+            ["Y", iid, [float(x) for x in rng.standard_normal(FEATURES)]]))
+    for uid in users:
+        broker.send(UPDATE_TOPIC, KEY_UP, json.dumps(
+            ["X", uid, [float(x) for x in rng.standard_normal(FEATURES)],
+             []]))
+    return users, items
+
+
+def _raw_get(port, path, headers=None, timeout=20):
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}",
+                                 headers=headers or {})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, dict(r.headers), r.read()
+
+
+def _raw_get_any(port, path, headers=None, timeout=20):
+    try:
+        return _raw_get(port, path, headers=headers, timeout=timeout)
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+def _await(predicate, what, timeout=30.0):
+    deadline = Deadline.after(timeout)
+    while not deadline.expired:
+        try:
+            if predicate():
+                return
+        except (urllib.error.URLError, OSError, KeyError):
+            pass
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _flush(port):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/admin/cache/flush", data=b"",
+        method="POST")
+    with urllib.request.urlopen(req, timeout=15) as r:
+        return json.loads(r.read())
+
+
+def _verdict(headers):
+    return headers.get("X-Oryx-Cache")
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    """2 shards + async cached router + threaded cached router +
+    uncached cold router."""
+    broker = get_broker(BROKER)
+    users, items = _publish_model(broker)
+    replicas = []
+    for s in range(2):
+        layer = ServingLayer(_config(**{
+            "oryx.cluster.enabled": True,
+            "oryx.cluster.shard": f"{s}/2"}), port=0)
+        layer.start()
+        replicas.append(layer)
+    a_sync = RouterLayer(_config(**_cached_overlay(**{
+        "oryx.cluster.async.enabled": True})), port=0)
+    a_sync.start()
+    threaded = RouterLayer(_config(**_cached_overlay()), port=0)
+    threaded.start()
+    cold = RouterLayer(_config(), port=0)
+    cold.start()
+
+    def ready(router):
+        return _raw_get(router.port, "/ready")[0] in (200, 204)
+
+    def fully_loaded(layer):
+        meta = json.loads(_raw_get(layer.port, "/shard/meta")[2])
+        return meta.get("users", 0) >= len(users)
+
+    for r in (a_sync, threaded, cold):
+        _await(lambda rr=r: ready(rr), "router readiness")
+    _await(lambda: all(fully_loaded(r) for r in replicas),
+           "full replica replay")
+    yield {"replicas": replicas, "async": a_sync,
+           "threaded": threaded, "cold": cold, "broker": broker,
+           "users": users, "items": items}
+    for layer in replicas + [a_sync, threaded, cold]:
+        try:
+            layer.close()
+        except Exception:  # noqa: BLE001 — teardown best effort
+            pass
+
+
+# -- 1. byte identity ---------------------------------------------------------
+
+def test_async_miss_and_hit_byte_identical_to_threaded_and_cold(cluster):
+    a, t, c = cluster["async"], cluster["threaded"], cluster["cold"]
+    _flush(a.port)
+    _flush(t.port)
+    for uid in cluster["users"][:3]:
+        for qs in ("?howMany=5", "?howMany=10&offset=3",
+                   "?howMany=4&considerKnownItems=true"):
+            path = f"/recommend/{uid}{qs}"
+            _, _, cold_body = _raw_get(c.port, path)
+            s1, h1, miss_body = _raw_get(a.port, path)
+            s2, h2, hit_body = _raw_get(a.port, path)
+            assert (s1, s2) == (200, 200)
+            assert _verdict(h1) == "miss" and _verdict(h2) == "hit"
+            assert miss_body == cold_body == hit_body, path
+            # ... and identical to the THREADED cached router's bytes
+            _, ht, tb = _raw_get(t.port, path)
+            assert tb == cold_body
+            assert _verdict(ht) in ("miss", "hit")
+
+
+def test_async_wider_cacheable_surface_byte_identical(cluster):
+    a, c = cluster["async"], cluster["cold"]
+    uid, items = cluster["users"][0], cluster["items"]
+    i1, i2 = items[0], items[1]
+    for path in (f"/similarity/{i1}/{i2}?howMany=5",
+                 f"/similarityToItem/{i1}/{i2}/{items[2]}",
+                 f"/estimate/{uid}/{i1}/{i2}",
+                 f"/because/{uid}/{i1}?howMany=4",
+                 f"/mostSurprising/{uid}",
+                 f"/knownItems/{uid}",
+                 f"/recommendToMany/{uid}/{cluster['users'][1]}",
+                 f"/recommendToAnonymous/{i1}=2.0/{i2}",
+                 f"/recommendWithContext/{uid}/{i1}=1.5",
+                 f"/estimateForAnonymous/{i1}/{i2}=0.5"):
+        _, _, cold_body = _raw_get(c.port, path)
+        _, h1, b1 = _raw_get(a.port, path)
+        _, h2, b2 = _raw_get(a.port, path)
+        assert b1 == cold_body == b2, path
+        assert _verdict(h2) == "hit", path
+
+
+def test_async_csv_and_gzip_variants_byte_identical(cluster):
+    a, c = cluster["async"], cluster["cold"]
+    uid = cluster["users"][1]
+    path = f"/recommend/{uid}?howMany=14&considerKnownItems=true"
+    _raw_get(a.port, path)  # prime via the JSON form
+    # CSV from the ON-LOOP hit path == cold render
+    hdr = {"Accept": "text/csv"}
+    _, _, cold_csv = _raw_get(c.port, path, headers=hdr)
+    _, h, csv1 = _raw_get(a.port, path, headers=hdr)
+    assert _verdict(h) == "hit" and csv1 == cold_csv
+    # gzip variant round-trips and reuses the stored bytes
+    gz_hdr = {"Accept-Encoding": "gzip"}
+    _, _, cold_gz = _raw_get(c.port, path, headers=gz_hdr)
+    _, h, gz1 = _raw_get(a.port, path, headers=gz_hdr)
+    assert _verdict(h) == "hit"
+    assert h.get("Content-Encoding") == "gzip"
+    assert gzip.decompress(gz1) == gzip.decompress(cold_gz)
+    _, _, gz2 = _raw_get(a.port, path, headers=gz_hdr)
+    assert gz2 == gz1
+
+
+def test_async_negative_404_served_on_loop(cluster):
+    a, c = cluster["async"], cluster["cold"]
+    path = "/recommend/no-such-user-async?howMany=5"
+    sc, _, cold_body = _raw_get_any(c.port, path)
+    s1, h1, b1 = _raw_get_any(a.port, path)
+    s2, h2, b2 = _raw_get_any(a.port, path)
+    assert sc == s1 == s2 == 404
+    assert _verdict(h1) == "miss" and _verdict(h2) == "hit"
+    assert b1 == b2 == cold_body
+
+
+def test_async_coalesced_burst_collapses_to_one_scatter(cluster):
+    a, c = cluster["async"], cluster["cold"]
+    _flush(a.port)
+    uid = cluster["users"][3]
+    path = f"/recommend/{uid}?howMany=7"
+    _, _, cold_body = _raw_get(c.port, path)
+    before = a.result_cache.stats()["coalesced_requests"]
+    results = []
+    barrier = threading.Barrier(8)
+
+    def one():
+        barrier.wait()
+        s, h, b = _raw_get(a.port, path, timeout=30)
+        results.append((s, _verdict(h), b))
+
+    threads = [threading.Thread(target=one) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30.0)
+    assert len(results) == 8
+    assert all(s == 200 and b == cold_body for s, _, b in results)
+    assert {v for _, v, _ in results} <= {"miss", "coalesced", "hit"}
+    after = a.result_cache.stats()
+    assert after["coalesced_requests"] + after["hits"] > before
+
+
+# -- 2. connection scale ------------------------------------------------------
+
+def _open_keepalive(port):
+    s = socket.create_connection(("127.0.0.1", port), timeout=20)
+    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return s
+
+
+def _request_on(sock, path):
+    sock.sendall(f"GET {path} HTTP/1.1\r\nHost: a\r\n\r\n"
+                 .encode("latin-1"))
+
+
+def _read_response(rfile):
+    status_line = rfile.readline(65537)
+    if not status_line:
+        raise ConnectionError("closed")
+    status = int(status_line.split(b" ", 2)[1])
+    clen = 0
+    while True:
+        h = rfile.readline(65537)
+        if h in (b"\r\n", b"\n", b""):
+            break
+        if h[:15].lower() == b"content-length:":
+            clen = int(h[15:])
+    body = b""
+    while len(body) < clen:
+        got = rfile.read(clen - len(body))
+        if not got:
+            raise ConnectionError("short body")
+        body += got
+    return status, body
+
+
+def test_1k_concurrent_keepalive_connections_flat_thread_count(cluster):
+    """The acceptance IT: >= 1k concurrent keep-alive sockets against
+    the async front end on the cache-hit workload — every response a
+    full 200, and the process thread count FLAT while the socket
+    count grew 16x (connections cost fds, not stacks)."""
+    a = cluster["async"]
+    uid = cluster["users"][0]
+    path = f"/recommend/{uid}?howMany=10"
+    _raw_get(a.port, path)  # prime the entry
+    n = 1024
+    socks = []
+    try:
+        for _ in range(64):
+            socks.append(_open_keepalive(a.port))
+        # one request per socket at 64 connections: the thread
+        # baseline AFTER the loop and bridge are warm
+        rfiles = [s.makefile("rb") for s in socks]
+        for s in socks:
+            _request_on(s, path)
+        for rf in rfiles:
+            status, body = _read_response(rf)
+            assert status == 200
+        threads_at_64 = threading.active_count()
+        while len(socks) < n:
+            s = _open_keepalive(a.port)
+            socks.append(s)
+            rfiles.append(s.makefile("rb"))
+        _await(lambda: cluster["async"]._frontend.open_connections
+               >= n, "server sees all connections", timeout=30.0)
+        # every connection answers — all 1024 in flight as far as the
+        # server is concerned (requests written before any read)
+        expected = None
+        for s in socks:
+            _request_on(s, path)
+        ok = 0
+        for rf in rfiles:
+            status, body = _read_response(rf)
+            assert status == 200
+            expected = expected or body
+            assert body == expected
+            ok += 1
+        assert ok == n
+        threads_at_n = threading.active_count()
+        # 16x the sockets, ~0x the threads: the bounded bridge pool
+        # (and nothing per-connection) is the only thread source
+        assert threads_at_n - threads_at_64 <= 8, \
+            (threads_at_64, threads_at_n)
+        fe = a._frontend
+        assert fe.fast_hits >= n  # the hits never left the loop
+    finally:
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+def test_connection_cap_sheds_fast_503_never_hangs(cluster):
+    """A dedicated async router with a tiny cap: connections up to the
+    cap serve; the next one gets a FAST 503 and a close."""
+    router = RouterLayer(_config(**_cached_overlay(**{
+        "oryx.cluster.async.enabled": True,
+        "oryx.cluster.async.max-connections": 8})), port=0)
+    router.start()
+    try:
+        _await(lambda: _raw_get(router.port, "/ready")[0]
+               in (200, 204), "cap router readiness")
+        uid = cluster["users"][0]
+        path = f"/recommend/{uid}?howMany=5"
+        held = []
+        try:
+            for _ in range(8):
+                s = _open_keepalive(router.port)
+                held.append((s, s.makefile("rb")))
+            _await(lambda: router._frontend.open_connections >= 8,
+                   "cap reached")
+            # held connections still serve
+            _request_on(held[0][0], path)
+            status, _ = _read_response(held[0][1])
+            assert status == 200
+            # the 9th: fast 503, closed, bounded time — never a hang
+            t0 = time.monotonic()
+            s9 = _open_keepalive(router.port)
+            rf9 = s9.makefile("rb")
+            status, _ = _read_response(rf9)
+            assert status == 503
+            assert rf9.readline() == b""  # server closed it
+            assert time.monotonic() - t0 < 5.0
+            assert router._frontend.rejected_connections >= 1
+            s9.close()
+        finally:
+            for s, _ in held:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+    finally:
+        router.close()
+
+
+# -- 3. chaos: a handler blocks the loop --------------------------------------
+
+def test_async_loop_block_chaos_watchdog_counts(cluster):
+    """``async-loop-block``: a handler does synchronous work ON the
+    loop — the watchdog measures the stall, counts it, and the router
+    keeps serving afterwards."""
+    router = RouterLayer(_config(**_cached_overlay(**{
+        "oryx.cluster.async.enabled": True,
+        "oryx.cluster.async.watchdog-interval-ms": 40,
+        "oryx.cluster.async.watchdog-stall-ms": 100})), port=0)
+    router.start()
+    try:
+        _await(lambda: _raw_get(router.port, "/ready")[0]
+               in (200, 204), "watchdog router readiness")
+        uid = cluster["users"][0]
+        path = f"/recommend/{uid}?howMany=5"
+        _raw_get(router.port, path)
+        faults.inject("async-loop-block", mode="delay", times=1,
+                      delay_sec=0.6)
+        _raw_get(router.port, path)  # this one blocks the loop
+        assert faults.fired("async-loop-block") == 1
+        _await(lambda: router._frontend.loop_stalls >= 1,
+               "watchdog counted the stall")
+        # the counter is on the metrics surface too
+        _, _, m = _raw_get(router.port, "/metrics")
+        assert json.loads(m)["counters"].get("async_loop_stalls",
+                                             0) >= 1
+        # and the loop recovered: requests keep flowing
+        assert _raw_get(router.port, path)[0] == 200
+    finally:
+        router.close()
+
+
+def test_async_front_end_serves_admin_and_writes_through_bridge(cluster):
+    """Non-cacheable surface rides the bridge pool: admin endpoints,
+    metrics, and the write path behave exactly as on the threaded
+    server."""
+    a = cluster["async"]
+    _, _, m = _raw_get(a.port, "/metrics")
+    m = json.loads(m)
+    assert "cluster" in m and "cache" in m["cluster"]
+    assert m["freshness"]["async_open_connections"] >= 0
+    st = json.loads(_raw_get(a.port, "/admin/cache")[2])
+    assert st["enabled"]
+    # write path: /pref flows to the input topic
+    broker = cluster["broker"]
+    end_before = broker.latest_offset("AIn")
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{a.port}/pref/au0/ai1", data=b"2.5",
+        method="POST")
+    with urllib.request.urlopen(req, timeout=15) as r:
+        assert r.status in (200, 204)
+    assert broker.latest_offset("AIn") == end_before + 1
+    # 405 parity for unknown methods on a known path
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{a.port}/recommend/au0", method="PUT")
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=15)
+    assert e.value.code == 405

@@ -433,6 +433,68 @@ def test_check_regression_gateway_mirror_cell_back_compat(tmp_path,
     assert not report["regressions"]
 
 
+def test_check_regression_gateway_conns_cell_gates_on_sustained_qps(
+        tmp_path, capsys):
+    """The r14 connection-count rung (C10K front end, ISSUE 12) gates
+    as its own pseudo-cell: the async front end losing throughput at
+    high connection counts fails the gate even when the low-
+    concurrency cold cell held; socket/thread telemetry rides along."""
+    prev = _gateway_doc([(50, 65536, 1, 100.0)])
+    prev["rows"][0]["conns"] = {
+        "connections": 4096, "open_loop_sustained_qps": 900.0,
+        "router_threads_at_load": 44, "hit_p50_ms": 0.8}
+    cur = _gateway_doc([(50, 65536, 1, 101.0)])
+    cur["rows"][0]["conns"] = {
+        "connections": 4096, "open_loop_sustained_qps": 400.0,
+        "router_threads_at_load": 45, "hit_p50_ms": 2.2}
+    rc = cr.main(["--kind", "gateway",
+                  "--previous", _write(tmp_path,
+                                       "BENCH_GATEWAY_r13.json", prev),
+                  "--current", _write(tmp_path,
+                                      "BENCH_GATEWAY_r14.json", cur)])
+    assert rc == 1
+    report = json.loads(capsys.readouterr().out)
+    assert [c["cell"] for c in report["regressions"]] == \
+        ["50f/0.065536M/1rep/conns"]
+    # errors during the rung zero the gated number: also a failure
+    cur["rows"][0]["conns"]["open_loop_sustained_qps"] = 0.0
+    rc = cr.main(["--kind", "gateway",
+                  "--previous", _write(tmp_path,
+                                       "BENCH_GATEWAY_r13.json", prev),
+                  "--current", _write(tmp_path,
+                                      "BENCH_GATEWAY_r14.json", cur)])
+    assert rc == 1
+    # and a healthy rung gates green
+    cur["rows"][0]["conns"]["open_loop_sustained_qps"] = 950.0
+    rc = cr.main(["--kind", "gateway",
+                  "--previous", _write(tmp_path,
+                                       "BENCH_GATEWAY_r13.json", prev),
+                  "--current", _write(tmp_path,
+                                      "BENCH_GATEWAY_r14.json", cur)])
+    assert rc == 0
+
+
+def test_check_regression_gateway_conns_cell_back_compat(tmp_path,
+                                                         capsys):
+    """r13-and-earlier artifacts carry no conns rung: the pseudo-cell
+    is reported as new, never gated against them — and an old round
+    being compared AGAINST a conns round reports it missing without
+    failing."""
+    prev = _gateway_doc([(50, 65536, 1, 100.0)])           # r13 shape
+    cur = _gateway_doc([(50, 65536, 1, 99.0)])
+    cur["rows"][0]["conns"] = {
+        "connections": 4096, "open_loop_sustained_qps": 900.0}
+    rc = cr.main(["--kind", "gateway",
+                  "--previous", _write(tmp_path,
+                                       "BENCH_GATEWAY_r13.json", prev),
+                  "--current", _write(tmp_path,
+                                      "BENCH_GATEWAY_r14.json", cur)])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["new_cells"] == ["(50, 65536, 1, 1, 'conns')"]
+    assert not report["regressions"]
+
+
 def test_check_regression_gateway_discovers_rounds_and_skips_cross_backend(
         tmp_path, capsys):
     _write(tmp_path, "BENCH_GATEWAY_r07.json",
