@@ -148,8 +148,9 @@ def measure_routes(model, batch: int | None = None,
             for lsh_on in variants:
                 buckets, hp, mb = _lsh_parts(model, lsh_on)
                 costs = costs_lsh if lsh_on else costs_exact
-                point = ("route-measure-lsh" if lsh_on
-                         else "route-measure-exact")
+                point = (
+                    "route-measure-lsh" if lsh_on    # chaos-point: route-measure-lsh
+                    else "route-measure-exact")      # chaos-point: route-measure-exact
                 ctx: dict = {}
                 key = (n_rows, int(vecs.shape[1]), batch,
                        str(vecs.dtype), lsh_on, k, mb, kind)

@@ -74,7 +74,7 @@ class RDFServingModel(ServingModel):
         examples = [self._example(row) for row in rows]
         x = examples_to_matrix(examples, self.input_schema.num_features)
         with self._lock:
-            arrays = self._compiled()
+            arrays = self._compiled_locked()
             if self.input_schema.is_classification():
                 target = self.input_schema.target_feature_index
                 best = arrays.predict_proba(x).argmax(axis=1)
@@ -82,7 +82,9 @@ class RDFServingModel(ServingModel):
             values = arrays.predict_value(x)
         return [text_utils._render(float(v)) for v in values]
 
-    def _compiled(self) -> ForestArrays:
+    def _compiled_locked(self) -> ForestArrays:
+        # caller holds _lock (the _locked suffix contract): _arrays is
+        # invalidated under the lock by update_terminal_node
         if self._arrays is None:
             num_classes = 0
             if self.input_schema.is_classification():

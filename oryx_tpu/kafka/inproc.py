@@ -215,9 +215,14 @@ class _Partition:
                     for k, m, h in self.log[start:end]]
 
     def close(self) -> None:
-        if self._fd is not None:
-            os.close(self._fd)
-            self._fd = None
+        # under the lock: close() racing append()'s is-open check /
+        # re-open / os.write would close the fd between the check and
+        # the write — EBADF at best, a write into a recycled fd at
+        # worst (caught by the guarded-by lint)
+        with self._lock:
+            if self._fd is not None:
+                os.close(self._fd)
+                self._fd = None
 
 
 class _Topic:

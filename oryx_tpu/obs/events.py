@@ -139,22 +139,26 @@ class WideEventLog:
                     self._f = open(self.path, "ab")
                     self._size = self._f.tell()
                 elif self._size + len(data) > self.max_bytes:
-                    self._rotate()
+                    self._rotate_locked()
                 self._f.write(data)
                 self._f.flush()
                 self._size += len(data)
                 self.emitted += 1
         except Exception:  # noqa: BLE001 — observability is best-effort
-            self.dropped += 1
+            # re-acquire: the with-block released on unwind, and a
+            # bare += here would race concurrent droppers (lost
+            # updates on the evidence counter — guarded-by lint)
+            with self._lock:
+                self.dropped += 1
             if self._registry is not None:
                 try:
                     self._registry.inc("event_write_failures")
                 except Exception:  # noqa: BLE001 — best-effort
                     pass
 
-    def _rotate(self) -> None:
+    def _rotate_locked(self) -> None:
         """events.jsonl -> .1 -> .2 ... oldest beyond max-files dies.
-        Called under the lock."""
+        Caller holds ``_lock`` (the ``_locked`` suffix contract)."""
         self._f.close()
         self._f = None
         oldest = f"{self.path}.{self.max_files - 1}"

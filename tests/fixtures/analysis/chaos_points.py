@@ -1,0 +1,19 @@
+"""Seeded drift defects, chaos side: a fault point fired but not
+documented in the fixture RESILIENCE.md (its inverse — documented but
+never fired — is seeded in the doc itself as ``fixture-stale``).
+NEVER imported — scanned as AST by tests/test_static_analysis.
+"""
+
+from oryx_tpu.resilience.faults import fire as _fault
+
+
+def replay(batch):
+    _fault("fixture-undocumented")  # SEEDED: no RESILIENCE.md row
+    for record in batch:
+        _fault("fixture-documented")
+        yield record
+
+
+def measure(point):
+    # dynamically composed names declare themselves by annotation:
+    _fault(point)  # chaos-point: fixture-annotated

@@ -1,0 +1,19 @@
+"""Seeded drift defects, config side: one key read but absent from
+the fixture reference.conf, one declared key never read.  The
+``# compat:`` annotated key and the prefix-literal subtree read are
+negative cases.  NEVER imported — scanned as AST by
+tests/test_static_analysis.
+"""
+
+
+def load(config):
+    known = config.get_int("oryx.fixture.known-key")
+    missing = config.get_string("oryx.fixture.unknown-key")  # SEEDED
+    base = "oryx.fixture.tuning"
+    depth = config.get_int(f"{base}.depth")
+    helper(config, "oryx.fixture.subtree")
+    return known, missing, depth
+
+
+def helper(config, prefix):
+    return config.get_optional_string(f"{prefix}.inner")

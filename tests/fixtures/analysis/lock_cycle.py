@@ -1,0 +1,99 @@
+"""Seeded lock-order defects.
+
+``Registry`` nests its two locks in opposite orders across methods —
+the classic two-thread deadlock.  ``SelfDeadlock`` re-acquires its
+own non-reentrant lock through a method call made while holding it —
+the obs/slo.py gauge-callback class.  ``Ordered`` is the negative
+case: a consistent A-then-B order is not a cycle.  The module-level
+``LOCK_L``/``LOCK_M`` trio seeds a cycle only reachable through a
+*mutually recursive* call pair (``_rec_a``/``_rec_b``): the M -> L
+edge exists only because ``_rec_b``'s transitive closure includes
+``_rec_a``'s acquisition, so a closure truncated mid-recursion loses
+the whole cycle.  NEVER imported — scanned as AST by
+tests/test_static_analysis.
+"""
+
+import threading
+
+LOCK_L = threading.Lock()
+LOCK_M = threading.Lock()
+LOCK_M2 = threading.Lock()
+
+
+def _rec_a():
+    with LOCK_L:
+        pass
+    _rec_b()
+
+
+def _rec_b():
+    _rec_a()
+
+
+def rec_entry_first():
+    # resolved before rec_entry_second: a truncated-memo closure would
+    # cache closure(_rec_b) = {} while computing closure(_rec_a) here
+    with LOCK_M2:
+        _rec_a()
+
+
+def rec_entry_second():
+    with LOCK_M:  # SEEDED: M -> L only via _rec_b's recursive closure
+        _rec_b()
+
+
+def l_then_m():
+    with LOCK_L:
+        with LOCK_M:  # SEEDED: ... and L -> M closes the cycle
+            pass
+
+
+class Registry:
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.items = {}
+
+    def ab(self):
+        with self._a:
+            with self._b:  # SEEDED: a -> b here ...
+                return len(self.items)
+
+    def ba(self):
+        with self._b:
+            with self._a:  # SEEDED: ... b -> a there
+                return sorted(self.items)
+
+
+class SelfDeadlock:
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0
+
+    def snapshot(self):
+        with self._lock:
+            return {"value": self._gauge()}
+
+    def _gauge(self):
+        with self._lock:  # SEEDED: called by snapshot() holding _lock
+            return self.value
+
+
+class Ordered:
+
+    def __init__(self):
+        self._outer = threading.Lock()
+        self._inner = threading.Lock()
+        self.n = 0
+
+    def one(self):
+        with self._outer:
+            with self._inner:
+                self.n += 1
+
+    def two(self):
+        with self._outer:
+            with self._inner:
+                self.n -= 1

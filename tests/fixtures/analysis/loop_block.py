@@ -1,0 +1,45 @@
+"""Seeded async-blocking defects: synchronous work on the event loop.
+
+The wrapped ``run_in_executor`` dispatch and the non-blocking
+``acquire(blocking=False)`` probe are negative cases.  NEVER
+imported — scanned as AST by tests/test_static_analysis.
+"""
+
+import asyncio
+import threading
+import time
+
+
+def _parse(raw):
+    return raw.split()
+
+
+class FrontEnd:
+
+    def __init__(self, pool):
+        self._pool = pool
+        self._lock = threading.Lock()
+
+    async def handle(self, raw):
+        time.sleep(0.01)                # SEEDED: sleeps the loop
+        parts = _parse(raw)
+        body = open(parts[0]).read()    # SEEDED: file I/O on the loop
+        self._lock.acquire()            # SEEDED: parks the loop
+        try:
+            return self._score(body)
+        finally:
+            self._lock.release()
+
+    async def fan_out(self, query):
+        return self._pool.scatter("GET", query)  # SEEDED: deny-list
+
+    def _score(self, body):
+        time.sleep(0.05)  # SEEDED: reached from async handle()
+        return len(body)
+
+    async def bridged(self, raw):
+        # negative: wrapped work runs off-loop, probe is non-blocking
+        if self._lock.acquire(blocking=False):
+            self._lock.release()
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(None, self._score, raw)

@@ -1,0 +1,41 @@
+"""Seeded guarded-by defects: the torn-snapshot / lost-update class.
+
+``invalidate`` mutates lock-guarded state without the lock — exactly
+the per-shard-reads-straddling-a-cutover bug class the pass exists
+for.  ``_purge_locked`` and the ``# guarded-by: none`` attribute are
+negative cases: the ``_locked`` convention and the opt-out must not
+fire.  NEVER imported — scanned as AST by tests/test_static_analysis.
+"""
+
+import threading
+
+
+class TopologyCache:
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._epoch = 0      # guarded-by: _lock
+        self._entries = {}
+        self.loop_stats = 0  # guarded-by: none — single-thread owner
+
+    def absorb(self, key, value):
+        with self._lock:
+            self._entries[key] = value
+            self._epoch += 1
+
+    def invalidate(self, key):
+        self._entries.pop(key, None)  # SEEDED: mutation without lock
+        self._epoch += 1              # SEEDED: RMW without lock
+
+    def _purge_locked(self):
+        self._entries.clear()  # fine: caller holds the lock
+
+    def reset(self):
+        with self._lock:
+            self._purge_locked()
+
+    def tick(self):
+        self.loop_stats += 1  # fine: declared unguarded
+
+    def annotate_only(self):
+        self._entries: dict  # fine: bare annotation, not a store
