@@ -42,10 +42,10 @@ import asyncio
 import io
 import logging
 import threading
-import time
 import urllib.parse
 from concurrent.futures import ThreadPoolExecutor
 
+from ..common import clock as clockmod
 from ..lambda_rt.http import (_KNOWN_METHODS, _REASONS, _render_kind,
                               render_error_page, wants_csv)
 from ..resilience import faults
@@ -400,7 +400,7 @@ class AsyncFrontEnd:
             return None
         if not (rc.store_enabled or rc.coalesce):
             return None
-        t0 = time.perf_counter()
+        t0 = clockmod.monotonic()
         parsed = urllib.parse.urlparse(target)
         path = urllib.parse.unquote(parsed.path)
         if app.context_path and path.startswith(app.context_path):
@@ -508,7 +508,7 @@ class AsyncFrontEnd:
         if not head_only:
             out += payload
         route_key = f"{route.method} {route.pattern}"
-        dur = time.perf_counter() - t0
+        dur = clockmod.monotonic() - t0
         if app.metrics is not None:
             app.metrics.record(route_key, status, dur,
                                trace_id=trace_id)

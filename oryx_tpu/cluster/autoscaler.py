@@ -44,10 +44,10 @@ import logging
 import os
 import sys
 import threading
-import time
 import urllib.request
 from dataclasses import dataclass, field
 
+from ..common import clock as clockmod
 from ..obs.prom import LATENCY_BUCKETS_MS, bucket_quantile
 from ..obs.slo import is_data_plane as _data_plane
 from ..resilience.policy import Supervisor
@@ -327,7 +327,7 @@ class Autoscaler:
     def __init__(self, policy: AutoscalePolicy,
                  launcher: ReplicaLauncher, router_url: str,
                  poll_interval_sec: float = 5.0, metrics=None,
-                 fetch=fetch_json, clock=time.monotonic):
+                 fetch=fetch_json, clock=clockmod.monotonic):
         self.policy = policy
         self.launcher = launcher
         self.router_url = router_url.rstrip("/")
@@ -524,7 +524,7 @@ class Autoscaler:
                 self.step(self.poll_signals())
             except Exception:  # noqa: BLE001 — the supervisor must
                 _log.exception("autoscale poll failed")  # outlive polls
-            stop.wait(self.poll_interval_sec)
+            clockmod.wait(stop, self.poll_interval_sec)
 
 
 def run_autoscaler(config, conf_path: str | None,

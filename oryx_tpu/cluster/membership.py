@@ -65,11 +65,11 @@ from __future__ import annotations
 import json
 import logging
 import threading
-import time
 import uuid
 from dataclasses import dataclass
 from typing import Iterable, Iterator
 
+from ..common import clock as clockmod
 from ..kafka.api import KeyMessage
 from ..resilience import faults
 
@@ -146,7 +146,7 @@ class MembershipRegistry:
     answers as ``m/N`` against the true topology.
     """
 
-    def __init__(self, ttl_sec: float, clock=time.monotonic,
+    def __init__(self, ttl_sec: float, clock=clockmod.monotonic,
                  region: str | None = None):
         self.ttl_sec = ttl_sec
         self._clock = clock
@@ -547,7 +547,7 @@ class HeartbeatPublisher:
             url=self.url,
             generation=int(getattr(self._manager, "generation", 0)),
             ready=model is not None and fraction >= self._min_fraction,
-            fraction=fraction, ts=time.time(), region=self.region,
+            fraction=fraction, ts=clockmod.now(), region=self.region,
             tport=self.tport)
 
     def publish_once(self) -> bool:
@@ -572,7 +572,7 @@ class HeartbeatPublisher:
     def _run(self) -> None:
         while not self._stop.is_set():
             self.publish_once()
-            self._stop.wait(self.interval_sec)
+            clockmod.wait(self._stop, self.interval_sec)
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self._run, daemon=True,

@@ -72,8 +72,8 @@ from __future__ import annotations
 import json
 import logging
 import threading
-import time
 
+from ..common import clock as clockmod
 from ..common import store
 from ..common.config import Config
 from ..kafka import utils as kafka_utils
@@ -196,8 +196,14 @@ class MirrorLayer:
     lifecycle contract as the other layers, so ``python -m oryx_tpu
     mirror`` runs supervised (deploy/main.py)."""
 
-    def __init__(self, config: Config):
+    def __init__(self, config: Config,
+                 clock: clockmod.Clock | None = None):
         self.config = config
+        # the injectable clock seam: the deterministic cluster
+        # simulation (oryx_tpu/sim) drives a MirrorLayer under virtual
+        # time, and the staleness-gauge tests pin their windows on a
+        # ManualClock instead of racing real-sleep margins
+        self._clock = clock if clock is not None else clockmod.get()
         r = "oryx.cluster.region"
         self.region = config.get_optional_string(f"{r}.name")
         if not self.region:
@@ -254,7 +260,7 @@ class MirrorLayer:
         # construction: a mirror that has NEVER confirmed sync (e.g.
         # started into an already-partitioned link) must report
         # staleness climbing from its start, not a forever-0
-        self._caught_up_mono: float = time.monotonic()
+        self._caught_up_mono: float = self._clock.monotonic()
         # None until the source head has been OBSERVED at least once: a
         # mirror restarted into a dead link must report unknown (null),
         # never a constructor-seeded 0 that reads as "caught up"
@@ -313,7 +319,7 @@ class MirrorLayer:
         clock is seeded at construction, so a mirror started INTO a
         partition climbs from its start)."""
         since_sync = int(
-            (time.monotonic() - self._caught_up_mono) * 1000)
+            (self._clock.monotonic() - self._caught_up_mono) * 1000)
         base = self._last_batch_staleness_ms or 0
         return base + since_sync
 
@@ -426,12 +432,12 @@ class MirrorLayer:
         if all(c <= s for s, c in zip(starts, capped)):
             # fully drained: stamp the caught-up confirmation the
             # staleness gauge measures from
-            self._caught_up_mono = time.monotonic()
+            self._caught_up_mono = self._clock.monotonic()
             self._last_batch_staleness_ms = 0
             return 0
         replayed = 0
         oldest_ts: int | None = None
-        t_drain = time.time()
+        t_drain = self._clock.time()
         # per-partition replay preserves each partition's record order
         # (Kafka's guarantee — all the convergence argument needs)
         for p in range(len(ends)):
@@ -475,7 +481,7 @@ class MirrorLayer:
         self.checkpoint.save()
         if all(self.checkpoint.source.get(p, 0) >= e
                for p, e in enumerate(ends)):
-            self._caught_up_mono = time.monotonic()
+            self._caught_up_mono = self._clock.monotonic()
         return replayed
 
     def _loop(self) -> None:
@@ -497,10 +503,10 @@ class MirrorLayer:
                     _log.warning("mirror poll failed (%d so far); "
                                  "holding position, staleness climbing",
                                  self.link_failures, exc_info=True)
-                self._stop.wait(self.poll_interval_sec)
+                self._clock.wait(self._stop, self.poll_interval_sec)
                 continue
             if drained == 0:
-                self._stop.wait(self.poll_interval_sec)
+                self._clock.wait(self._stop, self.poll_interval_sec)
             # a full batch replays again immediately: catch-up after a
             # healed partition must run at link speed, not poll speed
 

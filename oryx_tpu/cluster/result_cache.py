@@ -78,13 +78,13 @@ from __future__ import annotations
 import gzip as gzip_mod
 import json
 import threading
-import time
 from collections import OrderedDict
 from typing import Callable, NamedTuple
 
+from ..common import clock as clockmod
 from ..resilience import faults
 
-_monotonic = time.monotonic
+_monotonic = clockmod.monotonic
 
 __all__ = ["ResultCache", "CacheEntry", "CacheProbe", "route_tags",
            "ShardResultCache"]
@@ -627,7 +627,7 @@ class ResultCache:
         timeout = self.coalesce_wait_sec
         if deadline is not None:
             timeout = min(timeout, max(0.0, deadline.remaining()))
-        fl.event.wait(timeout)
+        clockmod.wait(fl.event, timeout)
         if fl.done and fl.entry is not None:
             with self._lock:
                 self.coalesced += 1

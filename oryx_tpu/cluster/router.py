@@ -31,12 +31,12 @@ from __future__ import annotations
 import json
 import logging
 import threading
-import time
 import urllib.parse
 from typing import Sequence
 
 import numpy as np
 
+from ..common import clock as clockmod
 from ..api.serving import OryxServingException
 from ..common.config import Config
 from ..kafka import utils as kafka_utils
@@ -1031,7 +1031,7 @@ class RouterLayer:
     def await_(self) -> None:
         if self._frontend is not None:
             while self._frontend.is_alive():
-                time.sleep(1.0)
+                clockmod.sleep(1.0)
             return
         while self._server_thread and self._server_thread.is_alive():
             self._server_thread.join(1.0)

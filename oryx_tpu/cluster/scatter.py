@@ -45,12 +45,12 @@ import re
 import secrets
 import socket
 import threading
-import time
 import urllib.parse
 from concurrent.futures import ThreadPoolExecutor
 from queue import Empty, SimpleQueue
 from typing import Sequence
 
+from ..common import clock as clockmod
 from ..api.serving import OryxServingException
 from ..resilience import faults
 from ..resilience.policy import CircuitBreaker, CircuitOpenError, Deadline
@@ -111,7 +111,7 @@ class _Pool:
         self.idle_ttl_sec = idle_ttl_sec
         self.max_per_url = max(1, max_per_url)
         self._tls = None
-        self._last_sweep = time.monotonic()
+        self._last_sweep = clockmod.monotonic()
         self.idle_evictions = 0
         self.cap_evictions = 0
 
@@ -122,7 +122,7 @@ class _Pool:
         sat unused that long has likely been dropped by the far end
         (or a middlebox), and handing it out just buys a stale-socket
         retry."""
-        now = time.monotonic()
+        now = clockmod.monotonic()
         stale = []
         try:
             with self._lock:
@@ -157,7 +157,7 @@ class _Pool:
         dropped = []
         with self._lock:
             stack = self._conns.setdefault(url, [])
-            stack.append((conn_rf[0], conn_rf[1], time.monotonic()))
+            stack.append((conn_rf[0], conn_rf[1], clockmod.monotonic()))
             while len(stack) > self.max_per_url:
                 # oldest-idle first: the bound sheds the sockets least
                 # likely to be reused
@@ -171,7 +171,7 @@ class _Pool:
         """Reclaim idle-past-TTL sockets across EVERY url and drop
         empty url keys — the long-gone-replica path: once its sockets
         age out nothing references the URL again."""
-        now = time.monotonic()
+        now = clockmod.monotonic()
         stale = []
         with self._lock:
             if now - self._last_sweep < max(1.0, self.idle_ttl_sec / 4):
@@ -441,14 +441,14 @@ class ScatterGather:
 
     def note_queue_wait(self, url: str, ms: float) -> None:
         with self._lock:
-            self._queue_waits[url] = (ms, time.monotonic())
+            self._queue_waits[url] = (ms, clockmod.monotonic())
 
     def cluster_queue_wait_ms(self) -> float | None:
         """The cluster's effective scoring queue wait: per shard the
         MIN over its replica group (the best member routing could
         pick), then the MAX over shards (every scatter waits for its
         slowest shard).  None until any replica has reported."""
-        now = time.monotonic()
+        now = clockmod.monotonic()
         with self._lock:
             value, at = self._qw_cache
             if now - at <= self.QUEUE_WAIT_CACHE_SEC:
@@ -632,13 +632,13 @@ class ScatterGather:
 
     def _framed_call(self, hb, shard, method, path, body, headers,
                      timeout, traceparent, cancel):
-        t0 = time.monotonic()
+        t0 = clockmod.monotonic()
         try:
             status, raw, _ = self.transport.request(
                 hb, method, path, body, headers, timeout, cancel=cancel)
         except StreamAbandoned:
             return self._abandon()
-        self._record_frame_span(traceparent, t0, time.monotonic(),
+        self._record_frame_span(traceparent, t0, clockmod.monotonic(),
                                 hb, shard, status)
         return self._finish_attempt(hb, shard, status, raw)
 
@@ -780,12 +780,12 @@ class ScatterGather:
             """Wait up to ``window`` (None = until deadline/timeout) for
             a success; failures decrement in-flight and keep waiting."""
             nonlocal in_flight
-            t_end = time.monotonic() + (window if window is not None
+            t_end = clockmod.monotonic() + (window if window is not None
                                         else self.shard_timeout_sec)
             if deadline is not None:
                 t_end = min(t_end, deadline.t_end)
             while in_flight:
-                wait = t_end - time.monotonic()
+                wait = t_end - clockmod.monotonic()
                 if wait <= 0:
                     return None
                 try:

@@ -60,11 +60,11 @@ import logging
 import socket
 import struct
 import threading
-import time
 import urllib.parse
 from concurrent.futures import ThreadPoolExecutor
 from queue import Empty, SimpleQueue
 
+from ..common import clock as clockmod
 from ..resilience import faults
 
 _log = logging.getLogger(__name__)
@@ -165,7 +165,7 @@ class _ClientConn:
         self._streams: dict[int, SimpleQueue] = {}
         self._next = 0
         self.dead = False
-        self.last_used = time.monotonic()
+        self.last_used = clockmod.monotonic()
         if ha1 is not None:
             write_frame(self.sock, FRAME_AUTH, 0,
                         json.dumps({"ha1": ha1}).encode(), self.wlock)
@@ -254,7 +254,7 @@ class FrameTransport:
             "oryx.serving.api.password")) if user else None
         self._conns: dict[tuple[str, int], _ClientConn] = {}
         self._lock = threading.Lock()
-        self._last_sweep = time.monotonic()
+        self._last_sweep = clockmod.monotonic()
         # operator counters (surfaced through ScatterGather.stats)
         self.cancels_sent = 0
         self.reconnects = 0
@@ -273,7 +273,7 @@ class FrameTransport:
         with self._lock:
             conn = self._conns.get(addr)
             if conn is not None and not conn.dead:
-                conn.last_used = time.monotonic()
+                conn.last_used = clockmod.monotonic()
                 return conn, True
         fresh = _ClientConn(addr, self.connect_timeout, self._ha1)
         with self._lock:
@@ -281,7 +281,7 @@ class FrameTransport:
             if cur is not None and not cur.dead:
                 # lost the connect race: ride the winner, drop ours
                 fresh.kill()
-                cur.last_used = time.monotonic()
+                cur.last_used = clockmod.monotonic()
                 return cur, True
             if cur is not None:
                 self.reconnects += 1
@@ -298,7 +298,7 @@ class FrameTransport:
         """Age out idle connections — the same eviction the scatter
         pool applies: a retired replica's ephemeral port must not pin
         a socket (and a map entry) forever."""
-        now = time.monotonic()
+        now = clockmod.monotonic()
         if now - self._last_sweep < max(1.0, self.idle_ttl_sec / 4):
             return
         with self._lock:
@@ -405,7 +405,7 @@ class FrameTransport:
             if registered is not None:
                 cancel.unregister(registered)
             conn.close_stream(stream)
-            conn.last_used = time.monotonic()
+            conn.last_used = clockmod.monotonic()
 
     @staticmethod
     def _abandon(conn: _ClientConn, stream: int) -> None:

@@ -1,0 +1,196 @@
+"""The injectable clock seam — every sim-covered module's one source
+of time.
+
+The deterministic cluster simulation (``oryx_tpu/sim``) runs a whole
+region pair in one process under *virtual* time: no call in a
+sim-covered module may read the wall clock or block the thread
+directly, or the simulation deadlocks (a real ``time.sleep`` stalls
+the single scheduler thread) and loses determinism (a real
+``time.monotonic`` leaks wall-clock jitter into decisions).  The
+``sim-clock`` analysis pass (analysis/sim_clock.py) enforces the rule
+mechanically: direct ``time.time()`` / ``time.monotonic()`` /
+``time.sleep()`` / ``Event.wait()`` calls in covered modules must
+route through this seam; justified exceptions live in the suppression
+ledger.
+
+Three implementations:
+
+- :class:`SystemClock` — the production default: real ``time.*`` and
+  real ``Event.wait``.  Installing nothing changes nothing.
+- :class:`ManualClock` — a thread-safe test clock: time moves only
+  when the test calls :meth:`ManualClock.advance`; ``sleep``/``wait``
+  *block* the calling thread until another thread advances past the
+  deadline (or the event sets).  This is how the formerly
+  timing-flaky tests pin their windows exactly instead of racing
+  real-sleep margins on a loaded box.
+- ``oryx_tpu/sim/clock.SimClock`` — the cooperative single-thread
+  virtual clock: ``sleep`` *advances* virtual time immediately and
+  never blocks (there is exactly one runnable context; a nested sleep
+  inside reused production code models an atomic step of that
+  duration).
+
+Call-time dispatch: the module-level functions (:func:`now`,
+:func:`monotonic`, :func:`sleep`, :func:`wait`) read the active clock
+on every call, so ``install()`` affects code that captured the
+*functions* at import time.  Objects that want per-instance clocks
+(MembershipRegistry, ResultCache, MirrorLayer) accept an explicit
+clock and default to the seam.
+"""
+
+from __future__ import annotations
+
+import threading
+import time as _time
+
+__all__ = ["Clock", "SystemClock", "ManualClock", "SYSTEM", "get",
+           "install", "installed", "now", "monotonic", "sleep", "wait"]
+
+
+class Clock:
+    """The seam protocol.  ``time()`` is wall-clock epoch seconds
+    (timestamps, record ``ts`` headers); ``monotonic()`` is the
+    scheduling/TTL/timeout clock; ``sleep`` blocks or advances;
+    ``wait`` is the seam's ``threading.Event.wait`` — it must honor an
+    event set by another thread AND the virtual timeout."""
+
+    def time(self) -> float:
+        raise NotImplementedError
+
+    def monotonic(self) -> float:
+        raise NotImplementedError
+
+    def sleep(self, seconds: float) -> None:
+        raise NotImplementedError
+
+    def wait(self, event: threading.Event,
+             timeout: float | None = None) -> bool:
+        raise NotImplementedError
+
+
+class SystemClock(Clock):
+    """Real time — the production default."""
+
+    def time(self) -> float:
+        return _time.time()
+
+    def monotonic(self) -> float:
+        return _time.monotonic()
+
+    def sleep(self, seconds: float) -> None:
+        _time.sleep(seconds)
+
+    def wait(self, event: threading.Event,
+             timeout: float | None = None) -> bool:
+        return event.wait(timeout)
+
+
+class ManualClock(Clock):
+    """Thread-safe virtual clock for tests with REAL threads: time
+    moves only via :meth:`advance`.  ``sleep``/``wait`` park the
+    caller on a condition until the clock passes their deadline (or
+    the event sets), so a test controls exactly how long a window
+    lasts — no real-sleep margin can flake under scheduler load.
+
+    ``advance`` wakes every waiter whose deadline passed; waiters
+    re-check under the lock, so concurrent advances are safe.  Seed
+    the start values from the real clocks (the default) so concurrent
+    readers outside the test see a plausible frozen time rather than
+    zero."""
+
+    def __init__(self, start_monotonic: float | None = None,
+                 start_time: float | None = None):
+        self._cond = threading.Condition()
+        self._mono = (_time.monotonic() if start_monotonic is None
+                      else start_monotonic)
+        self._wall = _time.time() if start_time is None else start_time
+
+    def time(self) -> float:
+        with self._cond:
+            return self._wall
+
+    def monotonic(self) -> float:
+        with self._cond:
+            return self._mono
+
+    def advance(self, seconds: float) -> None:
+        """Move both clocks forward and wake every sleeper/waiter."""
+        if seconds < 0:
+            raise ValueError(f"cannot advance by {seconds}")
+        with self._cond:
+            self._mono += seconds
+            self._wall += seconds
+            self._cond.notify_all()
+
+    def sleep(self, seconds: float) -> None:
+        with self._cond:
+            deadline = self._mono + max(0.0, seconds)
+            while self._mono < deadline:
+                self._cond.wait()
+
+    def wait(self, event: threading.Event,
+             timeout: float | None = None) -> bool:
+        with self._cond:
+            deadline = (None if timeout is None
+                        else self._mono + max(0.0, timeout))
+            while not event.is_set():
+                if deadline is not None and self._mono >= deadline:
+                    break
+                # bounded real wait so an event set by a thread that
+                # does not know about this clock still wakes us
+                self._cond.wait(0.05)
+            return event.is_set()
+
+
+SYSTEM = SystemClock()
+_active: Clock = SYSTEM
+_install_lock = threading.Lock()
+
+
+def get() -> Clock:
+    """The active clock (the seam's dispatch target)."""
+    return _active
+
+
+def install(clock: Clock) -> Clock:
+    """Install ``clock`` process-wide; returns the previous one.
+    Production never calls this — it is the test/simulation hook."""
+    global _active
+    with _install_lock:
+        prev = _active
+        _active = clock
+        return prev
+
+
+class installed:
+    """``with clock.installed(ManualClock()) as mc:`` — scoped install
+    that always restores, even on failure."""
+
+    def __init__(self, clock: Clock):
+        self.clock = clock
+        self._prev: Clock | None = None
+
+    def __enter__(self) -> Clock:
+        self._prev = install(self.clock)
+        return self.clock
+
+    def __exit__(self, *exc) -> None:
+        assert self._prev is not None
+        install(self._prev)
+
+
+def now() -> float:
+    """Wall-clock epoch seconds via the active clock."""
+    return _active.time()
+
+
+def monotonic() -> float:
+    return _active.monotonic()
+
+
+def sleep(seconds: float) -> None:
+    _active.sleep(seconds)
+
+
+def wait(event: threading.Event, timeout: float | None = None) -> bool:
+    """``event.wait(timeout)`` through the seam."""
+    return _active.wait(event, timeout)

@@ -43,12 +43,14 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Iterator
 
+from ..common import clock as clockmod
 from ..common.io_utils import mkdirs
 from ..resilience import faults
 from .api import KeyMessage, TopicProducer
 from .partitioner import partition_for_key
 
-__all__ = ["InProcBroker", "get_broker", "resolve_broker", "InProcTopicProducer"]
+__all__ = ["InProcBroker", "get_broker", "resolve_broker",
+           "drop_broker", "InProcTopicProducer"]
 
 _REGISTRY: dict[str, "InProcBroker"] = {}
 _REGISTRY_LOCK = threading.Lock()
@@ -77,6 +79,20 @@ def get_broker(name: str = "default", persist_dir: str | None = None) -> "InProc
                 f"broker {name!r} already exists with persist_dir="
                 f"{broker._persist_dir!r}, requested {persist_dir!r}")
         return broker
+
+
+def drop_broker(name: str) -> bool:
+    """Close and forget a named broker.  The registry is
+    process-global; a harness that creates uniquely-named brokers per
+    run (the cluster simulation sweeps hundreds of them) must be able
+    to release their logs, or the process accretes every run's
+    records."""
+    with _REGISTRY_LOCK:
+        broker = _REGISTRY.pop(name, None)
+    if broker is None:
+        return False
+    broker.close()
+    return True
 
 
 def resolve_broker(broker_uri: str) -> "InProcBroker":
@@ -487,7 +503,7 @@ class InProcBroker:
                 p = 0 if from_beginning \
                     else t.partitions[part].latest_offset()
             pos.append(p)
-        idle_since = time.monotonic()
+        idle_since = clockmod.monotonic()
         next_part = 0
         try:
             while True:
@@ -499,12 +515,13 @@ class InProcBroker:
                     if stop is not None and stop.is_set():
                         return
                     if (max_idle_sec is not None
-                            and time.monotonic() - idle_since > max_idle_sec):
+                            and clockmod.monotonic() - idle_since
+                            > max_idle_sec):
                         return
                     with t.cond:
                         # bounded wait: an append between the size check
                         # and this wait costs at most one poll interval
-                        t.cond.wait(poll_timeout_sec)
+                        t.cond.wait(poll_timeout_sec)  # wall-clock: Condition poll; sim drives consume via read_range, never this loop
                     # appends from other processes sharing the
                     # persisted logs never signal our Condition
                     t.refresh_all()
@@ -513,7 +530,7 @@ class InProcBroker:
                 key, message, headers = t.partitions[part].get(pos[part])
                 pos[part] += 1
                 next_part = (part + 1) % n
-                idle_since = time.monotonic()
+                idle_since = clockmod.monotonic()
                 # Commit only after the consumer's processing (the code
                 # between yields) completes and it comes back for more:
                 # at-least-once, matching the reference's
@@ -563,8 +580,8 @@ class InProcBroker:
         # at-least-once contract already allows.  Consumers flush()
         # on exit (consume's finally) to bound the window.
         if self._offsets_path:
-            self._offsets_dirty_since = self._offsets_dirty_since or time.monotonic()
-            if (time.monotonic() - self._offsets_last_write
+            self._offsets_dirty_since = self._offsets_dirty_since or clockmod.monotonic()
+            if (clockmod.monotonic() - self._offsets_last_write
                     >= _OFFSET_FLUSH_SEC):
                 self._write_offsets_locked()
 
@@ -590,7 +607,7 @@ class InProcBroker:
                            for (g, t, p), v in merged.items()}, f)
             os.replace(tmp, self._offsets_path)
             self._offsets_dirty_since = None
-            self._offsets_last_write = time.monotonic()
+            self._offsets_last_write = clockmod.monotonic()
 
     def flush(self) -> None:
         with self._lock:

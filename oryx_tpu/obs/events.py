@@ -31,6 +31,7 @@ import os
 import threading
 import time
 
+from ..common import clock as clockmod
 from ..resilience import faults
 
 __all__ = ["FIELDS", "WideEventLog", "events_from_config"]
@@ -115,7 +116,7 @@ class WideEventLog:
         drop + ``event_write_failures`` on any error, including the
         ``obs-event-disk-full`` chaos stand-in for ENOSPC)."""
         try:
-            event = {"ts_ms": int(time.time() * 1000), "route": route,
+            event = {"ts_ms": int(clockmod.now() * 1000), "route": route,
                      "status": status,
                      "latency_ms": round(latency_ms, 3)}
             if trace_id:

@@ -26,9 +26,9 @@ headers simply don't feed the end-to-end gauge.
 
 from __future__ import annotations
 
-import time
 from typing import Callable, Iterable, Iterator
 
+from ..common import clock as clockmod
 from ..kafka.api import KEY_MODEL, KEY_MODEL_REF, KeyMessage
 
 __all__ = ["UpdateStreamTap", "topic_lag_fn", "group_lag_fn",
@@ -55,7 +55,7 @@ class UpdateStreamTap:
         for km in it:
             self._count += 1
             if km.key in (KEY_MODEL, KEY_MODEL_REF):
-                self._last_model_mono = time.monotonic()
+                self._last_model_mono = clockmod.monotonic()
             yield km
 
     @property
@@ -66,7 +66,7 @@ class UpdateStreamTap:
         """Seconds since the last model generation went by; None until
         one has."""
         t = self._last_model_mono
-        return None if t is None else round(time.monotonic() - t, 3)
+        return None if t is None else round(clockmod.monotonic() - t, 3)
 
 
 def topic_lag_fn(broker_uri: str, topic: str,

@@ -26,8 +26,8 @@ from __future__ import annotations
 import logging
 import os
 import threading
-import time
 
+from ..common import clock as clockmod
 from ..resilience import faults
 
 _log = logging.getLogger(__name__)
@@ -76,19 +76,19 @@ def capture_profile(profile_dir: str, ms: int) -> dict:
     try:
         import jax
         trace_dir = os.path.join(profile_dir,
-                                 f"profile-{int(time.time() * 1000)}")
+                                 f"profile-{int(clockmod.now() * 1000)}")
         os.makedirs(trace_dir, exist_ok=True)
-        t0 = time.monotonic()
+        t0 = clockmod.monotonic()
         jax.profiler.start_trace(trace_dir)
         try:
             # chaos seam: a stalled profiler backend — the capture slows
             # but serving threads are untouched (this runs on the
             # requesting handler's thread only)
             faults.fire("obs-profile-slow")
-            time.sleep(ms / 1000.0)
+            clockmod.sleep(ms / 1000.0)
         finally:
             jax.profiler.stop_trace()
-        wall_ms = round((time.monotonic() - t0) * 1000.0, 1)
+        wall_ms = round((clockmod.monotonic() - t0) * 1000.0, 1)
         _log.info("Captured device profile (%s ms) to %s", wall_ms,
                   trace_dir)
         return {"trace_dir": trace_dir,

@@ -17,9 +17,9 @@ tests/test_obs_catalog.py.
 
 from __future__ import annotations
 
-import time
 from bisect import bisect_left
 from typing import Iterable, Mapping
+from ..common import clock as clockmod
 
 __all__ = ["LATENCY_BUCKETS_MS", "Histogram", "bucket_quantile",
            "merge_histograms", "merge_snapshots", "render_prometheus",
@@ -64,7 +64,7 @@ class Histogram:
         if trace_id is not None:
             if self.exemplars is None:
                 self.exemplars = {}
-            self.exemplars[i] = (trace_id, ms, time.time())
+            self.exemplars[i] = (trace_id, ms, clockmod.now())
 
     def snapshot(self) -> dict:
         out = {"buckets": list(self.counts),

@@ -33,9 +33,9 @@ worked example).
 from __future__ import annotations
 
 import threading
-import time
 from collections import deque
 
+from ..common import clock as clockmod
 from ..resilience import faults
 from .prom import LATENCY_BUCKETS_MS
 
@@ -176,7 +176,7 @@ class SloEngine:
     def __init__(self, objectives: list[SloObjective], registry,
                  fast_burn: float = 14.4, slow_burn: float = 6.0,
                  resolution_sec: float = 15.0,
-                 clock=time.monotonic):
+                 clock=clockmod.monotonic):
         self.objectives = list(objectives)
         self._registry = registry
         self.fast_burn = float(fast_burn)

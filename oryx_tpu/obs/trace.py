@@ -33,9 +33,9 @@ import json
 import logging
 import random
 import threading
-import time
 from collections import OrderedDict
 
+from ..common import clock as clockmod
 from ..resilience import faults
 
 _log = logging.getLogger(__name__)
@@ -146,7 +146,7 @@ class Span:
         self.trace_id = trace_id
         self.span_id = _new_span_id()
         self.parent_id = parent_id
-        self.t_start = time.monotonic()
+        self.t_start = clockmod.monotonic()
         self.attrs: dict = {}
         self.status = "ok"
         self._prev = None
@@ -162,7 +162,7 @@ class Span:
             self.status = status
         self._tracer._record(self.name, self.trace_id, self.span_id,
                              self.parent_id, self.t_start,
-                             time.monotonic(), self.attrs, self.status)
+                             clockmod.monotonic(), self.attrs, self.status)
 
     def __enter__(self):
         self._prev = self._tracer._swap(self)
@@ -195,7 +195,7 @@ class Tracer:
         self._traces: "OrderedDict[str, list[dict]]" = OrderedDict()
         # anchor so spans recorded from stored monotonic stamps (the
         # batcher's enqueue time) still carry wall-clock start times
-        self._mono_anchor = time.time() - time.monotonic()
+        self._mono_anchor = clockmod.now() - clockmod.monotonic()
 
     # -- thread-current context ---------------------------------------------
 
@@ -242,7 +242,7 @@ class Tracer:
         span.attrs["http.status"] = status
         span.end("error" if status >= 500 or status == 0 else "ok")
         if self.slow_request_ms is not None:
-            dur_ms = (time.monotonic() - span.t_start) * 1000.0
+            dur_ms = (clockmod.monotonic() - span.t_start) * 1000.0
             if dur_ms >= self.slow_request_ms:
                 self._dump_slow(span.trace_id, route, dur_ms)
 

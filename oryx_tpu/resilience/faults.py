@@ -21,6 +21,10 @@ mode       effect at the call site
            code that survives ``Exception`` dies exactly as if the
            process were killed at that line
 ``delay``  sleep ``delay_sec``, then continue
+``hold``   park on the point's gate until :func:`release` (or a 30 s
+           safety cap) — a *deterministic* stall: the test decides
+           exactly which operations complete before the gate opens,
+           so ordering assertions never ride on sleep margins
 ``drop``   return ``"drop"`` — the call site discards the operation
 ``duplicate`` return ``"duplicate"`` — the call site performs the
            operation twice (producer-retry duplication)
@@ -41,13 +45,14 @@ from __future__ import annotations
 
 import logging
 import threading
-import time
 from typing import Callable
+from ..common import clock as clockmod
 
 _log = logging.getLogger(__name__)
 
 __all__ = ["InjectedFault", "InjectedCrash", "FaultSpec", "inject",
-           "clear", "fire", "fired", "configure_from_config"]
+           "clear", "fire", "fired", "release",
+           "configure_from_config"]
 
 
 class InjectedFault(Exception):
@@ -62,18 +67,21 @@ class InjectedCrash(BaseException):
 
 
 class FaultSpec:
-    __slots__ = ("point", "mode", "remaining", "delay_sec", "error")
+    __slots__ = ("point", "mode", "remaining", "delay_sec", "error",
+                 "gate")
 
     def __init__(self, point: str, mode: str = "error",
                  times: int | None = 1, delay_sec: float = 0.0,
                  error: Callable[[], BaseException] | None = None):
-        if mode not in ("error", "crash", "delay", "drop", "duplicate"):
+        if mode not in ("error", "crash", "delay", "hold", "drop",
+                        "duplicate"):
             raise ValueError(f"unknown fault mode {mode!r}")
         self.point = point
         self.mode = mode
         self.remaining = times  # None = unlimited
         self.delay_sec = delay_sec
         self.error = error
+        self.gate = threading.Event() if mode == "hold" else None
 
 
 _LOCK = threading.Lock()
@@ -119,6 +127,16 @@ def fired(point: str) -> int:
         return _FIRED.get(point, 0)
 
 
+def release(point: str) -> None:
+    """Open a ``mode="hold"`` point's gate: every caller parked at the
+    point resumes, and future activations pass straight through."""
+    with _LOCK:
+        spec = _SPECS.get(point)
+        gate = spec.gate if spec is not None else None
+    if gate is not None:
+        gate.set()
+
+
 def fire(point: str,
          error: Callable[[], BaseException] | None = None) -> str | None:
     """Consume one activation of ``point`` if armed.
@@ -143,9 +161,15 @@ def fire(point: str,
         _FIRED[point] = _FIRED.get(point, 0) + 1
         mode, delay = spec.mode, spec.delay_sec
         factory = spec.error or error
+        gate = spec.gate
     _log.info("Fault fired: %s mode=%s", point, mode)
     if mode == "delay":
-        time.sleep(delay)
+        clockmod.sleep(delay)
+        return None
+    if mode == "hold":
+        # safety cap: a test that forgets release() stalls one point
+        # for 30 s, not forever
+        clockmod.wait(gate, 30.0)
         return None
     if mode == "crash":
         raise InjectedCrash(f"injected crash at {point}")

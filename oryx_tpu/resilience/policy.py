@@ -18,10 +18,10 @@ from __future__ import annotations
 import logging
 import random
 import threading
-import time
 import weakref
 from typing import Any, Callable
 
+from ..common import clock as clockmod
 from .faults import InjectedFault
 
 _log = logging.getLogger(__name__)
@@ -37,7 +37,7 @@ def run_with_resubscribe(fn: Callable[[], Any], stop: "threading.Event",
                          what: str, backoff: "Backoff | None" = None,
                          log: logging.Logger | None = None,
                          healthy_reset_sec: float = 300.0,
-                         clock: Callable[[], float] = time.monotonic
+                         clock: Callable[[], float] = clockmod.monotonic
                          ) -> None:
     """Run a blocking subscription (``fn`` returns only on clean end)
     until it completes or ``stop`` is set, restarting it with backoff
@@ -72,7 +72,7 @@ def run_with_resubscribe(fn: Callable[[], Any], stop: "threading.Event",
             attempt += 1
             log.exception("%s failed; resubscribing (attempt %d)",
                           what, attempt)
-            stop.wait(backoff.delay(attempt))
+            clockmod.wait(stop, backoff.delay(attempt))
 
 
 class DeadlineExceeded(Exception):
@@ -118,14 +118,14 @@ class Deadline:
 
     @classmethod
     def after(cls, seconds: float) -> "Deadline":
-        return cls(time.monotonic() + seconds)
+        return cls(clockmod.monotonic() + seconds)
 
     @property
     def expired(self) -> bool:
-        return time.monotonic() >= self.t_end
+        return clockmod.monotonic() >= self.t_end
 
     def remaining(self) -> float:
-        return max(0.0, self.t_end - time.monotonic())
+        return max(0.0, self.t_end - clockmod.monotonic())
 
     def check(self, what: str = "call") -> None:
         if self.expired:
@@ -193,7 +193,7 @@ class Retry:
                     InjectedFault),
                  max_attempts: int = 5,
                  backoff: Backoff | None = None,
-                 sleep: Callable[[float], None] = time.sleep):
+                 sleep: Callable[[float], None] = clockmod.sleep):
         self.name = name
         self._retryable = retryable
         self.max_attempts = max(1, max_attempts)
@@ -277,7 +277,7 @@ class CircuitBreaker:
     def __init__(self, name: str, failure_threshold: int = 5,
                  reset_timeout_sec: float = 1.0,
                  half_open_probes: int = 1,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = clockmod.monotonic):
         self.name = name
         self.failure_threshold = max(1, failure_threshold)
         self.reset_timeout_sec = reset_timeout_sec
@@ -390,9 +390,9 @@ class Supervisor:
 
     def __init__(self, factory: Callable[[], Any], name: str = "layer",
                  max_restarts: int = 5, backoff: Backoff | None = None,
-                 sleep: Callable[[float], None] = time.sleep,
+                 sleep: Callable[[float], None] = clockmod.sleep,
                  healthy_reset_sec: float = 300.0,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = clockmod.monotonic):
         self.factory = factory
         self.name = name
         self.max_restarts = max_restarts

@@ -28,11 +28,11 @@ than the device time itself behind a high-latency tunnel.
 from __future__ import annotations
 
 import threading
-import time
 from typing import Iterable
 
 import numpy as np
 
+from ..common import clock as clockmod
 from ..resilience import faults
 from ..resilience.policy import Deadline, DeadlineExceeded
 
@@ -59,7 +59,7 @@ class _Job:
         self.done = threading.Event()
         self.result: list[tuple[str, float]] | None = None
         self.error: BaseException | None = None
-        self.t_enq = time.monotonic()
+        self.t_enq = clockmod.monotonic()
         self.deadline = deadline
         # (trace_id, parent_span_id) captured at submit on sampled
         # requests; None (the overwhelmingly common case) costs nothing
@@ -171,7 +171,7 @@ class TopNBatcher:
         if stopped:
             return model.top_n_batch([how_many], job.vector[None, :],
                                      [job.exclude])[0]
-        job.done.wait()
+        job.done.wait()  # wall-clock: caller blocks on a real worker thread
         if job.error is not None:
             raise job.error
         return job.result
@@ -182,7 +182,7 @@ class TopNBatcher:
         and the LIVE age of the oldest still-queued job — so a queue
         that stopped draining reports a growing wait, not the stale
         average of better times."""
-        now = time.monotonic()
+        now = clockmod.monotonic()
         with self._cond:
             ew = self._qwait_ewma if now - self._qwait_at <= 5.0 else 0.0
             oldest = (now - self._pending[0].t_enq) if self._pending \
@@ -238,13 +238,13 @@ class TopNBatcher:
             with self._cond:
                 while not self._stopped:
                     if not self._pending:
-                        self._cond.wait()
+                        self._cond.wait()  # wall-clock: Condition poll on the real dispatch thread
                         continue
                     # Hold-time is measured from the oldest pending
                     # arrival's age, not time since the last dispatch —
                     # a stale last-dispatch timestamp after an idle gap
                     # must not extend the hold.
-                    age = time.monotonic() - self._pending[0].t_enq
+                    age = clockmod.monotonic() - self._pending[0].t_enq
                     full = len(self._pending) >= self.max_batch
                     if self._in_flight >= self._in_flight_target():
                         # at the in-flight cap: a full queue must NOT
@@ -254,7 +254,7 @@ class TopNBatcher:
                         # pacing: a blocked dispatcher wakes on the next
                         # completion and drains everything that queued
                         # during one service interval.
-                        self._cond.wait()
+                        self._cond.wait()  # wall-clock: Condition poll on the real dispatch thread
                         continue
                     # below the in-flight cap: hold only briefly so a
                     # synchronized burst coalesces, then go.  A lone
@@ -272,20 +272,20 @@ class TopNBatcher:
                     wait = min(cap, self._exec_ewma / 8) - age
                     if full or wait <= 0:
                         break
-                    self._cond.wait(wait)
+                    self._cond.wait(wait)  # wall-clock: Condition poll on the real dispatch thread
                 if self._stopped:
                     jobs, self._pending = self._pending, []
                 else:
                     jobs = self._pending[:self.max_batch]
                     del self._pending[:self.max_batch]
                     self._in_flight += 1
-                    self._last_dispatch = time.monotonic()
+                    self._last_dispatch = clockmod.monotonic()
                 stopped = self._stopped
             scored = 0
             if jobs:
-                t0 = time.monotonic()
+                t0 = clockmod.monotonic()
                 scored = self._dispatch(jobs)
-                wall = time.monotonic() - t0
+                wall = clockmod.monotonic() - t0
             if not stopped:
                 with self._cond:
                     self._in_flight -= 1
@@ -297,7 +297,7 @@ class TopNBatcher:
                         # the deadline burst ends
                         self._cond.notify(2)
                         continue
-                    now = time.monotonic()
+                    now = clockmod.monotonic()
                     # decay toward recent walls so a transient stall
                     # (compile, GC) cannot pin the round-trip estimate
                     self._wall_min = min(self._wall_min * 1.02, wall)
@@ -363,7 +363,7 @@ class TopNBatcher:
                     "request deadline expired while queued")
                 j.done.set()
             jobs = [j for j in jobs if j.error is None]
-        t_pickup = time.monotonic()
+        t_pickup = clockmod.monotonic()
         if jobs:
             # queue wait of this drain = the oldest job's enqueue->pickup
             # age; EWMA'd so the admission signal tracks load, not one
@@ -414,7 +414,7 @@ class TopNBatcher:
                 status = "error"
                 for j in group:
                     j.error = e
-            next_exec_start = time.monotonic()
+            next_exec_start = clockmod.monotonic()
             if self._tracer is not None:
                 self._record_spans(group, t_exec, next_exec_start,
                                    status)

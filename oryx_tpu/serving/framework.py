@@ -9,10 +9,10 @@ ErrorResource.java:36 (the error-page forward target).
 
 from __future__ import annotations
 
-import time
 import zlib
 from typing import Any
 
+from ..common import clock as clockmod
 from ..api.serving import OryxServingException
 from ..lambda_rt.http import (HtmlResponse, Request, Route, TextResponse,
                               render_error_page)
@@ -43,7 +43,7 @@ def send_input(req: Request, line: str) -> None:
     # the speed layer can measure ingest→servable freshness end to
     # end; `traceparent` carries a sampled request's trace context so
     # the fold-in that makes this record servable joins its trace
-    headers = {"ts": str(int(time.time() * 1000))}
+    headers = {"ts": str(int(clockmod.now() * 1000))}
     tracer = req.context.get("tracer")
     if tracer is not None:
         cur = tracer.current()

@@ -1,0 +1,259 @@
+"""The seed-swept scenarios and the failing-seed repro contract.
+
+Each scenario is a pure function of its seed: build the topology,
+derive a fault schedule from the scenario RNG, run to the horizon,
+quiesce, then run the terminal invariants.  ``SimResult.trace_hash``
+is the determinism witness — running the same (scenario, seed) twice
+must produce identical hashes, which the sweep tests assert.
+
+On any invariant violation (or scheduler failure) the runner raises
+:class:`SimFailure` carrying the seed, the virtual time, the trace
+hash and the one-line repro command:
+
+    python -m oryx_tpu.sim --scenario <name> --seed <N> --trace
+
+which replays the identical run and dumps the decision trace.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .cluster import SimCluster
+from .faults import FaultAction, FaultSchedule, random_schedule
+from .invariants import InvariantViolation
+from .sched import Sleep, SimError
+from ..resilience.faults import InjectedCrash
+
+__all__ = ["SimResult", "SimFailure", "run_scenario", "SCENARIOS"]
+
+ENTITIES = [f"e{i:02d}" for i in range(16)]
+
+
+@dataclass
+class SimResult:
+    scenario: str
+    seed: int
+    trace_hash: str
+    steps: int
+    virtual_sec: float
+    stats: dict = field(default_factory=dict)
+    summary: dict = field(default_factory=dict)
+    trace: list | None = None
+
+
+class SimFailure(Exception):
+    """A seed exposed a violation.  The message IS the bug report:
+    invariant, seed, virtual time, trace hash, repro command."""
+
+    def __init__(self, scenario: str, seed: int, trace_hash: str,
+                 steps: int, t: float, cause: BaseException):
+        self.scenario = scenario
+        self.seed = seed
+        self.trace_hash = trace_hash
+        self.cause = cause
+        super().__init__(
+            f"{type(cause).__name__}: {cause}\n"
+            f"  scenario={scenario} seed={seed} steps={steps} "
+            f"t={t:.3f}s trace={trace_hash[:16]}\n"
+            f"  repro: python -m oryx_tpu.sim --scenario {scenario} "
+            f"--seed {seed} --trace")
+
+
+def _finish(cx: SimCluster, scenario: str, seed: int,
+            keep_trace: bool) -> SimResult:
+    summary = cx.final_checks()
+    return SimResult(
+        scenario=scenario, seed=seed,
+        trace_hash=cx.sched.trace_hash(), steps=cx.sched.step_no,
+        virtual_sec=cx.clock.monotonic(), stats=dict(cx.stats),
+        summary=summary,
+        trace=list(cx.sched.trace) if keep_trace else None)
+
+
+def _run(scenario: str, seed: int, keep_trace: bool, body) -> SimResult:
+    cx = SimCluster(seed, keep_trace=keep_trace)
+    try:
+        body(cx)
+        return _finish(cx, scenario, seed, keep_trace)
+    except (InvariantViolation, SimError, InjectedCrash) as e:
+        raise SimFailure(scenario, seed, cx.sched.trace_hash(),
+                         cx.sched.step_no, cx.clock.monotonic(),
+                         e) from e
+    finally:
+        cx.close()
+
+
+# -- scenario: mirror partition / heal replay --------------------------------
+
+def run_mirror_partition(seed: int, shards: int = 2,
+                         per_shard: int = 1, ops: int = 22,
+                         horizon: float = 6.0,
+                         keep_trace: bool = False) -> SimResult:
+    """Active-active two-region pair; the replication link is cut and
+    healed at seeded instants (every seed gets at least one
+    partition), with extra seeded chaos on top — mirror crashes in
+    the mid-replay fence window, replica/speed/router kills, link
+    delays, duplicate deliveries, stalls.  After heal + drain both
+    regions must hold byte-identical state with exactly-once
+    replay."""
+
+    def body(cx: SimCluster):
+        rng = cx.rng
+        for r in ("A", "B"):
+            cx.add_region(r)
+            cx.add_replica_fleet(r, shards, per_shard)
+        cx.publish_model("A")
+        cx.add_mirror("A", source_region="B")
+        cx.add_mirror("B", source_region="A")
+        for r in ("A", "B"):
+            cx.add_client(r, 0, ops, ENTITIES)
+        # every seed partitions at least one replication link; which
+        # one, when, and for how long is the seed's choice
+        link = ("A.mirror", "B.broker") if rng.random() < 0.5 \
+            else ("B.mirror", "A.broker")
+        t_cut = rng.uniform(0.6, horizon * 0.5)
+        t_heal = t_cut + rng.uniform(0.5, 2.5)
+        forced = [FaultAction(t_cut, "cut", *link),
+                  FaultAction(t_heal, "heal", *link)]
+        components = ([f"{r}.rep{shards}x{s}.{i}"
+                       for r in ("A", "B") for s in range(shards)
+                       for i in range(per_shard)]
+                      + ["A.speed", "B.speed", "A.router", "B.router",
+                         "A.mirror", "B.mirror"])
+        links = [("A.mirror", "B.broker"), ("B.mirror", "A.broker"),
+                 ("A.router", "A.rep"), ("B.router", "B.rep")]
+        extra = random_schedule(
+            rng, horizon, n=2 + rng.randrange(4),
+            components=components, links=links,
+            crashable=["A.mirror", "B.mirror"])
+        sched = FaultSchedule(forced + extra.actions)
+        cx.sched.spawn("fault-driver", sched.driver(cx))
+        cx.sched.run_until(horizon)
+        cx.quiesce()
+
+    return _run("mirror-partition", seed, keep_trace, body)
+
+
+# -- scenario: live reshard cutover ------------------------------------------
+
+def _reshard_driver(cx: SimCluster, region: str, new_of: int,
+                    per_shard: int, start_at: float):
+    """The reconciling control plane: declare the reshard target,
+    spawn the warming fleet once, and re-assert the declaration after
+    router restarts until the registry commits the atomic cutover."""
+    yield Sleep(start_at)
+    cx.sched.note(f"reshard.begin|{region}|{new_of}")
+    while True:
+        r = cx.router(region)
+        if r is not None:
+            st = r.registry.topology_status()
+            if st["merged_of"] == new_of:
+                cx.stats["cutover"] = 1
+                cx.sched.note(f"reshard.cutover|{region}")
+                return
+            if st["reshard_target"] != new_of:
+                r.registry.begin_reshard(new_of)
+                if not cx.stats.get("reshard_declared"):
+                    cx.stats["reshard_declared"] = 1
+                    for shard in range(new_of):
+                        for i in range(per_shard):
+                            cx.add_replica(region, shard, new_of, i)
+        yield Sleep(0.3)
+
+
+def _probe(cx: SimCluster, region: str, n: int):
+    """Query-only probe of the post-cutover ring; unlike a client it
+    never writes, so "no complete 200 in n tries" is a real liveness
+    failure and not the luck of a write-heavy op mix."""
+    from .net import NetError, RemoteError
+    for _ in range(n):
+        try:
+            resp = yield from cx.net.call(
+                f"{region}.probe", f"{region}.router",
+                {"op": "query"}, timeout=1.2)
+        except (NetError, RemoteError):
+            continue
+        if resp.get("status") == 200 and not resp.get("partial"):
+            cx.stats["probe_full"] += 1
+
+
+def run_reshard_cutover(seed: int, old_of: int = 2,
+                        new_of: int = 3, per_shard: int = 2,
+                        new_per_shard: int = 1, ops: int = 30,
+                        horizon: float = 6.0,
+                        keep_trace: bool = False) -> SimResult:
+    """A live 2→3 reshard under continuous client load with seeded
+    chaos: replica/speed/router kills and restarts, router↔replica
+    partitions, delays, duplicate deliveries, stalls — landing at
+    every point of the warming/cutover window across seeds.  The
+    single-snapshot and no-silently-partial invariants run on every
+    response; after quiesce the cutover must have committed and a
+    probe scan must return a complete 200 on the new ring."""
+
+    def body(cx: SimCluster):
+        rng = cx.rng
+        cx.add_region("A")
+        cx.add_replica_fleet("A", old_of, per_shard)
+        cx.publish_model("A")
+        cx.add_client("A", 0, ops, ENTITIES)
+        t_reshard = rng.uniform(0.8, 2.0)
+        cx.sched.spawn("reshard-driver",
+                       _reshard_driver(cx, "A", new_of,
+                                       new_per_shard, t_reshard))
+        components = ([f"A.rep{old_of}x{s}.{i}"
+                       for s in range(old_of)
+                       for i in range(per_shard)]
+                      + [f"A.rep{new_of}x{s}.{i}"
+                         for s in range(new_of)
+                         for i in range(new_per_shard)]
+                      + ["A.speed", "A.router"])
+        links = ([("A.router", f"A.rep{old_of}x{s}.{i}")
+                  for s in range(old_of) for i in range(per_shard)]
+                 + [("A.router", f"A.rep{new_of}")]
+                 + [("A.client0", "A.router")])
+        sched = random_schedule(
+            rng, horizon, n=2 + rng.randrange(4),
+            components=components, links=links,
+            allow=("kill", "cut", "delay", "duplicate", "stall"))
+        cx.sched.spawn("fault-driver", sched.driver(cx))
+        cx.sched.run_until(horizon)
+        cx.quiesce()
+        # liveness: once healed, the reconciler must drive the
+        # cutover home
+        cx.await_condition(
+            lambda: cx.stats.get("cutover") == 1, 12.0,
+            f"reshard to {new_of} never cut over after quiesce")
+        cx.quiesce()
+        # probe the new ring: a complete (non-partial) 200 at the new
+        # topology
+        cx.sched.spawn("A.probe", _probe(cx, "A", 4))
+        cx.sched.run_until(cx.clock.monotonic() + 2.0)
+        if cx.stats.get("probe_full", 0) < 1:
+            raise InvariantViolation(
+                "liveness",
+                "no complete 200 served on the new ring after "
+                "cutover + quiesce")
+        r = cx.router("A")
+        if r is None or r.registry.shard_count != new_of:
+            raise InvariantViolation(
+                "liveness",
+                f"routed topology is not {new_of} after cutover")
+
+    return _run("reshard-cutover", seed, keep_trace, body)
+
+
+SCENARIOS = {
+    "mirror-partition": run_mirror_partition,
+    "reshard-cutover": run_reshard_cutover,
+}
+
+
+def run_scenario(name: str, seed: int, keep_trace: bool = False,
+                 **kwargs) -> SimResult:
+    try:
+        fn = SCENARIOS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown scenario {name!r}; have {sorted(SCENARIOS)}")
+    return fn(seed, keep_trace=keep_trace, **kwargs)

@@ -1,0 +1,45 @@
+"""Seeded defects for the sim-clock pass (tests/test_static_analysis
+.py::test_fixture_sim_clock_fires).
+
+Positives: a direct monotonic read, an aliased sleep, and a raw event
+wait in a sim-covered module.  Negatives below the marker: the clock
+seam itself, an injected per-instance clock, and an annotated
+wall-clock exception — none may be flagged.
+"""
+
+import threading
+import time
+import time as _t
+
+from nowhere import clock as clockmod  # noqa: F401 (fixture only)
+
+
+class StalenessGauge:
+    def __init__(self, clock=None):
+        self._clock = clock
+        self._since = time.monotonic()          # direct-time
+        self._stop = threading.Event()
+
+    def backoff(self, delay: float) -> None:
+        _t.sleep(delay)                          # direct-time (alias)
+
+    def park(self, timeout: float) -> bool:
+        return self._stop.wait(timeout)          # event-wait
+
+
+# -- negatives: everything from here down must stay quiet -------------------
+
+class SeamUser:
+    def __init__(self, clock):
+        self._clock = clock
+
+    def ok_seam_module(self, ev, timeout):
+        clockmod.wait(ev, timeout)               # the seam itself
+        return clockmod.monotonic()
+
+    def ok_seam_instance(self, ev, timeout):
+        self._clock.wait(ev, timeout)            # injected clock
+
+    def ok_annotated(self):
+        t0 = time.time()  # wall-clock: profile file names need real timestamps
+        return t0

@@ -42,7 +42,11 @@ from oryx_tpu.common import pmml as pmml_io
 from oryx_tpu.kafka.api import KEY_MODEL_REF
 from oryx_tpu.kafka.inproc import resolve_broker
 
-pytestmark = pytest.mark.chaos
+pytestmark = [pytest.mark.chaos, pytest.mark.slow]
+# slow: this module is the retained real-process smoke for scenarios
+# whose tier-1 coverage moved to the deterministic simulation
+# (tests/test_sim_sweep.py) — hundreds of seeded interleavings per
+# run instead of one wall-clock interleaving per CI run.
 
 _USERS = [f"u{j}" for j in range(6)]
 _ITEMS = [f"i{j}" for j in range(60)]
@@ -260,6 +264,10 @@ def test_01_kill_group_member_zero_partials_zero_5xx(cluster):
 
 
 def test_02_live_reshard_2_to_3_under_continuous_load(cluster):
+    # retained as the real-process smoke for this scenario; the
+    # tier-1 coverage moved to the deterministic sim, which sweeps
+    # hundreds of cutover interleavings per run at ~0.05 s each
+    # (tests/test_sim_sweep.py, scenario "reshard-cutover")
     c = cluster
     # runbook step 1: declare the target
     status, st = _post_json(c.router_port, "/admin/topology", {"of": 3})

@@ -20,6 +20,7 @@ from oryx_tpu.cluster.membership import Heartbeat, MembershipRegistry
 from oryx_tpu.cluster.mirror import (H_ORIGIN_OFFSET, H_ORIGIN_PARTITION,
                                      H_ORIGIN_REGION, MirrorCheckpoint,
                                      MirrorLayer)
+from oryx_tpu.common.clock import ManualClock
 from oryx_tpu.common.config import from_dict
 from oryx_tpu.kafka.api import KEY_MODEL, KEY_UP
 from oryx_tpu.kafka.inproc import get_broker
@@ -221,12 +222,20 @@ def test_two_mirrors_a_b_never_ping_pong(tmp_path):
 
 
 def test_staleness_gauges_climb_through_a_partitioned_link(tmp_path):
+    # virtual clock: the climb windows are advanced by hand, so the
+    # "staleness grew" assertions can never flake under scheduler
+    # load — and the climb is exact, not merely monotone.  Pinned
+    # start values: with a real-time start, (t + 0.04) - t can floor
+    # to 39 ms for unlucky t, and the gauge is int-truncated
+    clock = ManualClock(start_monotonic=0.0,
+                        start_time=1_700_000_000.0)
     src_name, dst_name = _names()
     src = get_broker(src_name)
-    m = MirrorLayer(_mirror_config(tmp_path, src_name, dst_name))
+    m = MirrorLayer(_mirror_config(tmp_path, src_name, dst_name),
+                    clock=clock)
     try:
         src.send("OryxUpdate", KEY_UP, UP1,
-                 headers={"ts": str(int(time.time() * 1000) - 250)})
+                 headers={"ts": str(int(clock.time() * 1000) - 250)})
         assert m.poll_once() == 1
         # the drained batch carried a ts stamp: staleness is MEASURED
         assert m._last_batch_staleness_ms >= 250
@@ -238,14 +247,14 @@ def test_staleness_gauges_climb_through_a_partitioned_link(tmp_path):
         for _ in range(2):
             with pytest.raises(ConnectionError):
                 m.poll_once()
-        time.sleep(0.03)
+        clock.advance(0.04)
         g1 = m.metrics.gauges_snapshot()
-        assert g1["cross_region_staleness_ms"] > s0
+        assert g1["cross_region_staleness_ms"] >= s0 + 30
         assert g1["mirror_lag_records"] == 1
-        time.sleep(0.03)
+        clock.advance(0.04)
         g2 = m.metrics.gauges_snapshot()
         assert g2["cross_region_staleness_ms"] \
-            > g1["cross_region_staleness_ms"]
+            >= g1["cross_region_staleness_ms"] + 30
         # heal: one poll drains the backlog and the gauges collapse
         faults.clear("mirror-link-partition")
         assert m.poll_once() == 1

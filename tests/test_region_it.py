@@ -42,7 +42,11 @@ from oryx_tpu.common import pmml as pmml_io
 from oryx_tpu.kafka.api import KEY_MODEL, KEY_UP
 from oryx_tpu.kafka.inproc import resolve_broker
 
-pytestmark = pytest.mark.chaos
+pytestmark = [pytest.mark.chaos, pytest.mark.slow]
+# slow: this module is the retained real-process smoke for scenarios
+# whose tier-1 coverage moved to the deterministic simulation
+# (tests/test_sim_sweep.py) — hundreds of seeded interleavings per
+# run instead of one wall-clock interleaving per CI run.
 
 _USERS = [f"u{j}" for j in range(6)]
 _ITEMS = [f"i{j}" for j in range(24)]
@@ -295,6 +299,10 @@ def test_01_steady_state_fold_in_crosses_regions(regions):
 
 
 def test_02_partition_serve_local_climb_then_converge(regions):
+    # retained as the real-process smoke for this scenario; the
+    # tier-1 coverage moved to the deterministic sim, which sweeps
+    # hundreds of partition/heal interleavings per run at ~0.1 s each
+    # (tests/test_sim_sweep.py, scenario "mirror-partition")
     a, b = regions
     # === partition the link: replace both healthy mirrors with ones
     # whose every poll fails at the mirror-link-partition seam ===

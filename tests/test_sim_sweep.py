@@ -1,0 +1,103 @@
+"""Seed sweeps + the seed-regression corpus (ISSUE 16 acceptance).
+
+Tier-1 explores >=200 interleavings of EACH chaos scenario per CI
+run — the whole point of the simulation refactor.  For scale: the
+real-process ITs these sweeps cover explore exactly ONE interleaving
+per run at ~11 s (elastic 2→3 reshard, test_elastic_it.py) and
+~16 s (region partition/heal, test_region_it.py) apiece, while a sim
+seed costs ~52 ms (reshard-cutover) / ~130 ms (mirror-partition) —
+two-plus orders of magnitude per interleaving, far beyond the >=5x
+the acceptance asks.  The real ITs are retained as single ``-m
+slow`` smokes; tier-1 wall-clock stays inside its 870 s budget
+(pre-simulation baseline 360 s with both real ITs tier-1).
+
+Every sweep asserts a hard wall-clock budget in-test, and replay
+determinism is asserted two ways: a sampled re-run of sweep seeds
+must reproduce byte-identical trace hashes, and the pinned corpus in
+tests/fixtures/sim_seeds.toml (seeds that exposed real bugs during
+bring-up) runs green twice with hash equality every CI run.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import pytest
+import tomli
+
+from oryx_tpu.sim import SimFailure, run_scenario
+
+_FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
+                        "sim_seeds.toml")
+
+# tier-1 sweep shape: >=200 interleavings per scenario, each with a
+# hard wall-clock ceiling (~4x the measured cost, so a perf
+# regression that would blow the tier-1 budget fails HERE, named,
+# not as a mysterious global slowdown)
+_SWEEP_SEEDS = 200
+_BUDGETS_SEC = {"mirror-partition": 120.0, "reshard-cutover": 90.0}
+# seeds re-run after each sweep to assert trace-hash reproducibility
+_REPLAY_SAMPLE = (0, 67, 133, 199)
+
+
+def _corpus() -> list[dict]:
+    with open(_FIXTURE, "rb") as fh:
+        return tomli.load(fh)["seed"]
+
+
+def _corpus_ids() -> list[str]:
+    return [f"{e['scenario']}-{e['seed']}" for e in _corpus()]
+
+
+@pytest.mark.parametrize("entry", _corpus(), ids=_corpus_ids())
+def test_seed_regression_corpus(entry):
+    """Each pinned (scenario, seed) once exposed a real bug; replay
+    it twice — invariants must hold and the two trace hashes must be
+    byte-identical (same seed, same trace)."""
+    first = run_scenario(entry["scenario"], entry["seed"])
+    second = run_scenario(entry["scenario"], entry["seed"])
+    assert first.trace_hash == second.trace_hash, (
+        f"nondeterministic replay of pinned seed {entry['seed']} "
+        f"({entry['scenario']}): {first.trace_hash[:16]} != "
+        f"{second.trace_hash[:16]}")
+    assert first.steps == second.steps
+
+
+def _sweep(scenario: str, seeds) -> dict[int, str]:
+    hashes: dict[int, str] = {}
+    for seed in seeds:
+        try:
+            hashes[seed] = run_scenario(scenario, seed).trace_hash
+        except SimFailure as e:
+            # the message IS the bug report: invariant, seed, trace
+            # hash, and the one-line repro command
+            pytest.fail(str(e), pytrace=False)
+    return hashes
+
+
+@pytest.mark.parametrize("scenario", sorted(_BUDGETS_SEC))
+def test_sweep_200_interleavings(scenario):
+    """>=200 seeded interleavings, all invariants green, inside a
+    hard wall-clock budget; then a sampled replay must reproduce the
+    sweep's exact trace hashes."""
+    t0 = time.perf_counter()
+    hashes = _sweep(scenario, range(_SWEEP_SEEDS))
+    took = time.perf_counter() - t0
+    budget = _BUDGETS_SEC[scenario]
+    assert took < budget, (
+        f"{scenario} sweep of {_SWEEP_SEEDS} seeds took {took:.1f}s "
+        f"(budget {budget:.0f}s) — the simulation got too slow for "
+        f"tier-1")
+    assert len(hashes) == _SWEEP_SEEDS
+    for seed in _REPLAY_SAMPLE:
+        assert run_scenario(scenario, seed).trace_hash \
+            == hashes[seed], f"seed {seed} did not replay its trace"
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("scenario", sorted(_BUDGETS_SEC))
+def test_wide_sweep_1000_interleavings(scenario):
+    """The wide sweep: a thousand interleavings per scenario, beyond
+    the tier-1 200 — the nightly net for tail-seed bugs."""
+    _sweep(scenario, range(_SWEEP_SEEDS, _SWEEP_SEEDS + 1000))

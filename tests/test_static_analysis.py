@@ -1,4 +1,4 @@
-"""oryx-lint tier-1 wiring (ISSUE 14): the four static analysis
+"""oryx-lint tier-1 wiring (ISSUE 14): the five static analysis
 passes run clean over ``oryx_tpu/``, the suppression ledger is fully
 justified and never stale, the seeded-defect fixtures prove each pass
 actually fires, the ``--json`` report shape is golden-pinned for CI
@@ -198,6 +198,21 @@ def test_fixture_drift_fires(fixture_findings):
              "oryx.fixture.subtree.inner", "fixture-annotated",
              "fixture-documented"}
     assert not quiet & {f.symbol for f in fixture_findings}
+
+
+def test_fixture_sim_clock_fires(fixture_findings):
+    mine = [f for f in fixture_findings if f.pass_name == "sim-clock"]
+    assert _have(fixture_findings, "sim-clock", "direct-time",
+                 "time.monotonic")
+    # aliased import (`import time as _t`) still resolves
+    assert _have(fixture_findings, "sim-clock", "direct-time",
+                 "time.sleep")
+    assert _have(fixture_findings, "sim-clock", "event-wait",
+                 "self._stop.wait")
+    # negatives: the seam itself (clockmod.*, self._clock.wait) and
+    # the `# wall-clock:` annotation stay quiet
+    assert all(f.line < 30 for f in mine), \
+        "a clock-seam/annotated negative case was flagged"
 
 
 # -- CLI contract -----------------------------------------------------------
