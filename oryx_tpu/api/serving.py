@@ -72,11 +72,15 @@ class AbstractServingModelManager(ServingModelManager):
 
 class OryxServingException(Exception):
     """An error with an HTTP status, mapped to a plain-text error response
-    (reference: OryxServingException.java:26)."""
+    (reference: OryxServingException.java:26).  ``headers`` optionally
+    rides extra response headers out with the error page — the write
+    path's shed responses carry ``Retry-After`` this way."""
 
-    def __init__(self, status: int, message: str = ""):
+    def __init__(self, status: int, message: str = "",
+                 headers: dict | None = None):
         super().__init__(message)
         self.status = status
+        self.headers = headers
 
 
 class HasCSV(abc.ABC):

@@ -61,6 +61,19 @@ class ALSSpeedModelManager(AbstractSpeedModelManager):
             "oryx.speed.min-model-load-fraction")
         if not 0.0 <= self.min_model_load_fraction <= 1.0:
             raise ValueError("min-model-load-fraction must be in [0,1]")
+        # ring-sharded fold-in (oryx.speed.shard = "i/N"): the model
+        # state stays FULL — Gramian solvers need the whole catalog and
+        # the consume thread applies every UP/MODEL record — but
+        # build_updates folds only events whose ITEM this worker owns
+        # on the serving murmur2 ring, so N workers split the fold-in
+        # work by item slice exactly as replicas split scoring
+        shard_spec = config.get_optional_string("oryx.speed.shard")
+        if shard_spec:
+            from ...cluster.sharding import parse_shard_spec
+            self.shard_index, self.shard_count = parse_shard_spec(shard_spec)
+        else:
+            self.shard_index, self.shard_count = 0, 1
+        self.skipped_remote_events = 0
         self._log_rate_limit = RateLimitCheck(60.0)
         # integrity counters (mirrors the serving manager)
         self.rejected_updates = 0
@@ -182,6 +195,13 @@ class ALSSpeedModelManager(AbstractSpeedModelManager):
         model.precompute_solvers()
 
         events = als_common.parse_events(new_data)
+        if self.shard_count > 1:
+            from ...cluster.sharding import is_local_item
+            owned = [ev for ev in events
+                     if is_local_item(ev[1], self.shard_index,
+                                      self.shard_count)]
+            self.skipped_remote_events += len(events) - len(owned)
+            events = owned
         agg = als_common.aggregate(events, model.implicit,
                                    model.log_strength, model.epsilon)
         if len(agg.values) == 0:

@@ -23,6 +23,9 @@ Three artifact families share the machinery, selected by ``--kind``:
   back-compat.  Since r14 the connection-count rung (ISSUE 12, C10K
   front end) gates as the ``(..., "conns")`` pseudo-cell on qps
   sustained through the top rung's concurrent sockets, same
+  back-compat.  Since r15 the write-heavy rung (ISSUE 17,
+  ``--write-heavy``) gates as the ``(..., "writes")`` pseudo-cell on
+  sustained ACKED writes/s through the durable-ack ingest path, same
   back-compat.
 - ``obs``: ``BENCH_OBS_OVERHEAD_*.json`` — the observability
   hot-path microbench (bench/obs_overhead.py).  Gates on two rules:
@@ -212,6 +215,28 @@ def _cells(doc: dict) -> dict:
                     "router_threads_at_load":
                         conns.get("router_threads_at_load"),
                     "hit_p50_ms": conns.get("hit_p50_ms"),
+                }
+            # ISSUE 17 added the write-heavy rung (`--write-heavy`):
+            # it gates as its own (..., "writes") pseudo-cell on the
+            # highest sustained ACKED writes/s through the durable-ack
+            # ingest path (serving door -> input topic -> speed
+            # fold-in), so a write-path regression — gate, pipelined
+            # produce, or broker append — cannot hide behind a healthy
+            # read cell.  The acked==durable ledger and fold-in
+            # freshness ride along for diagnosis.  Pre-r15 artifacts
+            # simply lack the cell.
+            w = r.get("writes")
+            if isinstance(w, dict) \
+                    and w.get("open_loop_sustained_qps") is not None:
+                out[key + ("writes",)] = {
+                    "open_loop_sustained_qps":
+                        w["open_loop_sustained_qps"],
+                    "acked_equals_durable":
+                        w.get("acked_equals_durable"),
+                    "ingest_to_servable_ms":
+                        w.get("ingest_to_servable_ms"),
+                    "p50_shed_ms":
+                        (w.get("overload") or {}).get("p50_shed_ms"),
                 }
         return out
     return {(r["features"], r["items"], r["lsh"]): r

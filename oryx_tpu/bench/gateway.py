@@ -74,10 +74,20 @@ connection (transport connections per replica stay 1 through the
 storm).  ``--replica-cache`` arms the replica-side result cache
 (cluster/result_cache.py ShardResultCache) on every replica.
 
+``--write-heavy`` (ISSUE 17) adds the durable-ack ingest rung: a real
+serving door + a real ``speed --shard 0/1`` worker over one file://
+broker, an open-loop POST ``/pref`` rate ladder to the highest
+sustained ACKED writes/s (the headline), a tight-gate burst proving
+overload degrades to fast 503 + ``Retry-After`` (``ingest_sheds``),
+and the end-to-end accounting that every 200 is durable in the input
+topic and folds exactly once (``acked == durable``, zero dedup
+republishes, ``ingest_to_servable_ms``).
+
 Writes ``BENCH_GATEWAY_r14.json``; ``bench/check_regression.py
 --kind gateway`` gates successive rounds per (features, items,
 replicas, replicas-per-shard) cell, plus ``zipf`` / ``load`` /
-``mirror`` / ``conns`` pseudo-cells per row when those rungs ran.
+``mirror`` / ``conns`` / ``writes`` pseudo-cells per row when those
+rungs ran.
 """
 
 from __future__ import annotations
@@ -1189,6 +1199,213 @@ def run_mirror_probe(work_dir: str, records: int = 2000,
     }
 
 
+def _write_window(port: int, n_users: int, n_items: int,
+                  rate_qps: float, duration_sec: float,
+                  workers: int = 48) -> list[dict]:
+    """Fixed-rate POST ``/pref/{u}/{i}`` driver recording per-response
+    verdicts — status, Retry-After, latency — the write-path twin of
+    ``_probe_window``.  Every 200 is a durable-ack claim the probe's
+    broker-side accounting checks afterwards."""
+    import threading as th
+    n = max(1, int(rate_qps * duration_sec))
+    results: list[dict] = []
+    lock = th.Lock()
+    next_i = [0]
+    t0 = time.monotonic()
+
+    def worker():
+        while True:
+            with lock:
+                i = next_i[0]
+                if i >= n:
+                    return
+                next_i[0] += 1
+            scheduled = t0 + i / rate_qps
+            now = time.monotonic()
+            if scheduled > now:
+                time.sleep(scheduled - now)
+            sent = time.monotonic()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/pref/u{i % n_users}"
+                f"/i{i % n_items}", data=b"1.0", method="POST")
+            status, retry_after = 0, None
+            try:
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    r.read()
+                    status = r.status
+            except urllib.error.HTTPError as e:
+                status = e.code
+                retry_after = e.headers.get("Retry-After")
+                e.read()
+            except Exception:  # noqa: BLE001 — transport failure
+                status = 0
+            done = time.monotonic()
+            with lock:
+                results.append({
+                    "t": done - t0,
+                    "ms": (done - sent) * 1000.0,
+                    "status": status, "retry_after": retry_after})
+
+    threads = [th.Thread(target=worker, daemon=True)
+               for _ in range(workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return results
+
+
+def run_write_heavy_probe(work_dir: str, users: int = 200,
+                          items: int = 120, features: int = 4,
+                          rates: list[float] | None = None,
+                          duration: float = 2.5) -> dict:
+    """The ``--write-heavy`` rung (ISSUE 17): the durable-ack write
+    path measured end to end over real processes — one serving door
+    (``python -m oryx_tpu serving``, ingest gate armed) and one
+    ``speed --shard 0/1`` worker sharing a durable file:// broker.
+
+    Three measurements, one broker-side ledger:
+
+    - **sustained acked writes/s** (the gated headline): an open-loop
+      POST ``/pref`` rate ladder; a rung sustains only with ZERO
+      non-shed errors and zero sheds — a 200 is a durable-ack claim,
+      so the headline counts nothing weaker;
+    - **overload shape**: a second door with ``max-inflight-sends: 1``
+      takes a concurrent burst — overload must degrade to fast 503 +
+      ``Retry-After`` (``ingest_sheds``), never slow errors;
+    - **the ledger**: acked 200s across ALL windows must equal the
+      input-topic offset delta (nothing acked-but-lost, nothing
+      silently half-written), and after the speed worker drains, its
+      checkpoint fence + dedup counters prove every acked record
+      folded exactly once, with ``ingest_to_servable_ms`` as the
+      freshness evidence.
+    """
+    wr_dir = os.path.join(work_dir, "write-broker")
+    ckpt = os.path.join(work_dir, "write-speed-ckpt")
+    _publish_model(wr_dir, users, items, features)
+    api_port, tight_port = _free_port(), _free_port()
+    speed_obs = _free_port()
+    speed_conf = os.path.join(work_dir, "write-speed.conf")
+    _write_conf(speed_conf, wr_dir, _free_port(), {
+        "oryx.speed.model-manager-class":
+            "oryx_tpu.app.als.speed.ALSSpeedModelManager",
+        "oryx.speed.checkpoint-dir": ckpt,
+        "oryx.speed.streaming.generation-interval-sec": 1,
+        "oryx.obs.metrics-port": speed_obs,
+    })
+    serve_conf = os.path.join(work_dir, "write-serving.conf")
+    _write_conf(serve_conf, wr_dir, api_port, {
+        # bounded but generous: the ladder must measure the broker,
+        # not the gate — the tight door below measures the gate
+        "oryx.serving.ingest.max-inflight-sends": 64,
+        "oryx.serving.ingest.retry-after-sec": 1,
+    })
+    tight_conf = os.path.join(work_dir, "write-tight.conf")
+    _write_conf(tight_conf, wr_dir, tight_port, {
+        "oryx.serving.ingest.max-inflight-sends": 1,
+        "oryx.serving.ingest.retry-after-sec": 1,
+    })
+    log_path = os.path.join(work_dir, "write-probe.log")
+    broker = resolve_broker(f"file://{wr_dir}")
+    n0 = broker.latest_offset("GwIn")
+
+    def _speed_gauges() -> dict:
+        return _get_json(speed_obs, "/metrics").get("freshness", {})
+
+    procs = [_spawn(["speed", "--shard", "0/1"], speed_conf, None,
+                    log_path)]
+    try:
+        # the worker's fold fence starts at the CURRENT input head
+        # (tail semantics), so it must be up before the first write —
+        # and its model replayed, or early folds would be skipped
+        _await(lambda: (_speed_gauges().get("update_lag_records") == 0
+                        and _speed_gauges()
+                        .get("model_generation_age_sec") is not None),
+               "write probe speed worker model replay", timeout=300.0)
+        procs.append(_spawn(["serving"], serve_conf, None, log_path))
+        procs.append(_spawn(["serving"], tight_conf, None, log_path))
+        for port in (api_port, tight_port):
+            _await(lambda p=port: _get_json(p, "/ready") is None,
+                   "write probe serving door", timeout=300.0)
+
+        ladder, acked, sustained = [], 0, 0.0
+        for rate in rates or [150.0, 300.0, 600.0, 1200.0, 2400.0]:
+            results = _write_window(api_port, users, items, rate,
+                                    duration)
+            # a None-returning mutation renders as 204 (lambda_rt/
+            # http.py): that IS the durable ack
+            ok = [r for r in results if r["status"] in (200, 204)]
+            shed = [r for r in results if r["status"] == 503]
+            span = max(r["t"] for r in results)
+            achieved = round(len(ok) / span, 1) if span else 0.0
+            rung_ok = (len(ok) == len(results)
+                       and achieved >= 0.9 * rate)
+            ladder.append({
+                "offered_qps": rate, "requests": len(results),
+                "acked": len(ok), "shed_503": len(shed),
+                "other_errors": len(results) - len(ok) - len(shed),
+                "achieved_acked_qps": achieved,
+                "p50_ack_ms": round(float(np.percentile(
+                    [r["ms"] for r in ok], 50)), 1) if ok else None,
+                "sustained": rung_ok,
+            })
+            acked += len(ok)
+            if rung_ok:
+                sustained = achieved
+            else:
+                break
+
+        # the overload burst, against the tight door: concurrency >>
+        # the gate's one slot, so admission MUST shed — the shape of
+        # the shed (fast, Retry-After-stamped) is what's under test
+        over = _write_window(tight_port, users, items,
+                             max(2000.0, 2.0 * sustained), 1.5,
+                             workers=64)
+        over_ok = [r for r in over if r["status"] in (200, 204)]
+        over_shed = [r for r in over if r["status"] == 503]
+        acked += len(over_ok)
+        overload = {
+            "requests": len(over),
+            "acked": len(over_ok),
+            "shed_503": len(over_shed),
+            "shed_with_retry_after": sum(
+                1 for r in over_shed if r["retry_after"]),
+            "other_errors": len(over) - len(over_ok) - len(over_shed),
+            "p50_shed_ms": round(float(np.percentile(
+                [r["ms"] for r in over_shed], 50)), 1)
+            if over_shed else None,
+        }
+
+        # the ledger: every ack durable, every durable record folded
+        # exactly once — read AFTER the worker drains to the head
+        _await(lambda: _speed_gauges().get("input_lag_records") == 0,
+               "write probe fold-in drain", timeout=300.0)
+        durable = broker.latest_offset("GwIn") - n0
+        m = _get_json(speed_obs, "/metrics")
+        gauges = m.get("freshness", {})
+        counters = m.get("counters", {})
+        serving_counters = _get_json(tight_port, "/metrics").get(
+            "counters", {})
+    finally:
+        for p in procs:
+            p.kill()
+        for p in procs:
+            p.wait(timeout=15)
+    return {
+        "open_loop_sustained_qps": sustained,
+        "ladder": ladder,
+        "acked": acked,
+        "durable": durable,
+        "acked_equals_durable": acked == durable,
+        "overload": overload,
+        "ingest_sheds": serving_counters.get("ingest_sheds", 0),
+        "ingest_to_servable_ms": gauges.get("ingest_to_servable_ms"),
+        "speed_checkpoint_age_sec":
+            gauges.get("speed_checkpoint_age_sec"),
+        "dedup_skips": counters.get("speed_shard_dedup_skips", 0),
+    }
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--replicas", default="1,2,4",
@@ -1330,6 +1547,17 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--mirror-records", type=int, default=2000,
                     help="backlog size the mirror probe's healed "
                          "partition must catch up through")
+    ap.add_argument("--write-heavy", action="store_true",
+                    help="before the qps cells, run the durable-ack "
+                         "write rung (ISSUE 17): a real serving door "
+                         "+ speed worker, an open-loop POST /pref "
+                         "ladder to the highest sustained ACKED "
+                         "writes/s, a tight-gate overload burst, and "
+                         "the acked==durable==folded-once ledger")
+    ap.add_argument("--write-rates", default="",
+                    help="comma list of offered write rates for the "
+                         "write-heavy ladder (default 150..2400 "
+                         "doubling)")
     ap.add_argument("--load-compare", type=int, default=0,
                     help="before the qps cells, publish the catalog "
                          "BOTH ways and boot this many shards against "
@@ -1360,6 +1588,15 @@ def main(argv: list[str] | None = None) -> int:
             mirror_probe = run_mirror_probe(
                 work_dir, records=args.mirror_records)
             print(json.dumps(mirror_probe), file=sys.stderr)
+        write_probe = None
+        if args.write_heavy:
+            print("== write-heavy probe (durable-ack ingest) ==",
+                  file=sys.stderr)
+            write_probe = run_write_heavy_probe(
+                work_dir,
+                rates=[float(r) for r in args.write_rates.split(",")
+                       if r] or None)
+            print(json.dumps(write_probe), file=sys.stderr)
         load_compare = None
         if args.load_compare > 0:
             print("== load-compare probe (replay vs sliced) ==",
@@ -1428,6 +1665,10 @@ def main(argv: list[str] | None = None) -> int:
                 # the probe rides the FIRST row as its (..., "mirror")
                 # pseudo-cell — one measurement per round, one gate
                 row["mirror"] = mirror_probe
+            if write_probe is not None and not rows:
+                # same shape: the write-heavy rung rides the first row
+                # as the (..., "writes") pseudo-cell
+                row["writes"] = write_probe
             rows.append(row)
             print(json.dumps({k: v for k, v in rows[-1].items()
                               if k != "ladder"}), file=sys.stderr)
@@ -1450,6 +1691,7 @@ def main(argv: list[str] | None = None) -> int:
         "load_compare": load_compare,
         "regions": args.regions,
         "mirror_probe": mirror_probe,
+        "write_probe": write_probe,
         "zipf_a": args.zipf or None,
         "tracing_sample": args.tracing_sample,
         "emulated_device_ms_per_mrow": args.device_ms_per_mrow,

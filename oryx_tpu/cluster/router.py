@@ -704,6 +704,9 @@ def _metrics(req: Request):
     admission = req.context.get("admission")
     if admission is not None:
         out["cluster"]["admission"] = admission.stats()
+    ingest_gate = req.context.get("ingest_gate")
+    if ingest_gate is not None:
+        out["cluster"]["ingest"] = ingest_gate.stats()
     result_cache = req.context.get("result_cache")
     if result_cache is not None:
         out["cluster"]["cache"] = result_cache.stats()
@@ -879,6 +882,15 @@ class RouterLayer:
                 InProcTopicProducer(self.input_broker, self.input_topic),
                 retry=Retry.from_config("router-input-send", config),
                 breaker=self.input_breaker)
+        # write-path admission (serving/ingest.py), the scatter
+        # AdmissionController's twin: bounded in-flight input-topic
+        # appends + measured-send-lag shedding around the /ingest and
+        # /pref produce only — fast 503 + Retry-After + ingest_sheds,
+        # health/admin/read routes never gated
+        from ..serving.ingest import IngestGate
+        self.ingest_gate = IngestGate(config, self.metrics)
+        if not self.ingest_gate.enabled:
+            self.ingest_gate = None
         self._stop = threading.Event()
         self._consume_thread: threading.Thread | None = None
         self._server = None
@@ -900,6 +912,7 @@ class RouterLayer:
                 "tracer": self.tracer,
                 "config": config,
                 "input_producer": self.input_producer,
+                "ingest_gate": self.ingest_gate,
                 "admission":
                     self.admission if self.admission.enabled else None,
                 "result_cache": self.result_cache,

@@ -75,6 +75,15 @@ def _cmd_batch(args) -> int:
 def _cmd_speed(args) -> int:
     from ..lambda_rt.speed import SpeedLayer
     config = _load_config(args.conf)
+    if getattr(args, "shard", None):
+        # sharded fold-in worker: consume the full input topic, fold
+        # only the murmur2 item slices this worker owns, publish into
+        # the shared update topic (docs/SCALING.md "Sharded speed
+        # layer"); run one worker per slice
+        from ..cluster.sharding import parse_shard_spec
+        from ..common.config import from_dict
+        parse_shard_spec(args.shard)  # fail fast on a bad spec
+        config = from_dict({"oryx.speed.shard": args.shard}, config)
     _run_layer(lambda: SpeedLayer(config), "speed", config)
     return 0
 
@@ -304,6 +313,12 @@ def main(argv: list[str] | None = None) -> int:
                                 "--no-async forces the threaded "
                                 "server.  Default: "
                                 "oryx.cluster.async.enabled")
+        if name == "speed":
+            p.add_argument("--shard", default=None, metavar="i/N",
+                           help="fold in only item slice i of N "
+                                "(murmur2 ring); run N supervised "
+                                "workers to split fold-in work — all "
+                                "publish into the one update topic")
         if name == "serving":
             p.add_argument("--shard", default=None, metavar="i/N",
                            help="serve catalog shard i of N as a "

@@ -540,7 +540,10 @@ class HttpApp:
                     self._send_error(handler, e.status, str(e),
                                      headers={"X-Oryx-Cache": "miss"})
                     return
-                self._send_error(handler, e.status, str(e))
+                # e.headers (e.g. Retry-After on an ingest shed) ride
+                # out with the error page
+                self._send_error(handler, e.status, str(e),
+                                 headers=e.headers)
                 return
             except DeadlineExceeded as e:
                 # the request's time budget ran out while queued or in

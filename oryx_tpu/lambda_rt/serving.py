@@ -107,6 +107,14 @@ class ServingLayer:
                 InProcTopicProducer(self.input_broker, self.input_topic),
                 retry=Retry.from_config("serving-input-send", config),
                 breaker=self.input_breaker)
+        # write-path admission (serving/ingest.py; both gates 0 = off):
+        # bounded in-flight broker appends + measured-send-lag shedding
+        # around send_input/send_input_many ONLY — 503 + Retry-After,
+        # never a silently dropped acked record
+        from ..serving.ingest import IngestGate
+        self.ingest_gate = IngestGate(config)
+        if not self.ingest_gate.enabled:
+            self.ingest_gate = None
 
         routes = self._discover_routes()
         idle_ms = config.get_int(f"{api}.batch-idle-wait-ms")
@@ -170,6 +178,7 @@ class ServingLayer:
             context={
                 "model_manager": self.model_manager,
                 "input_producer": self.input_producer,
+                "ingest_gate": self.ingest_gate,
                 "config": config,
                 "min_model_load_fraction": self.min_model_load_fraction,
                 "top_n_batcher": self.top_n_batcher,

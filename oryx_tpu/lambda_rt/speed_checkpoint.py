@@ -1,0 +1,203 @@
+"""Durable micro-batch fence for the (sharded) speed layer.
+
+The speed layer's classic failure window is a kill between a
+micro-batch's UP publishes and its input-offset commit: on restart the
+batch redelivers, ``build_updates`` runs again — but against a model
+that has already *consumed* the first attempt's published deltas (the
+consume thread replays the whole update topic), so the recomputed
+vectors differ and the events are folded twice.  At-least-once reads
+are unavoidable; double-folded *effects* are not.
+
+The fix is the mirror's recipe (cluster/mirror.py) adapted to a
+producer: one atomic JSON checkpoint per worker holding
+
+- ``input``: next input-topic offset per partition — where the batch
+  loop resumes;
+- ``next_batch``: a persisted monotonic batch counter — batch identity
+  never depends on wall-clock, so deterministic replays (sim) and
+  restarts never collide;
+- ``dest_scanned``: update-topic offsets recovery has already
+  examined — the next scan is incremental;
+- ``pending``: the *write-ahead staged batch* — the exact update
+  strings, their base headers, and the input ``ends`` they cover,
+  written durably BEFORE the first publish.
+
+Every published UP delta carries ``speed-shard``/``speed-batch``/
+``speed-seq`` headers.  Recovery after a crash inside the window scans
+the DESTINATION (update) topic from ``dest_scanned`` for this worker's
+(shard, batch) records, treats the durable log itself as the arbiter
+of what landed, republishes ONLY the missing sequence numbers from the
+staged bytes — byte-identical to the first attempt, never re-derived
+against the already-moved model — and then commits.  Found sequences
+count as ``speed_shard_dedup_skips``.  The staged bytes are the whole
+exactly-once-effective argument: replayed records are SETs of the same
+bytes, so whatever interleaving of crash, replay, and producer-retry
+duplication occurs, the folded state converges to the uncrashed run's.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Callable, Iterable, Sequence
+
+from ..common import store
+
+_log = logging.getLogger(__name__)
+
+__all__ = ["SpeedCheckpoint", "recover_pending", "stamp_headers",
+           "H_SPEED_SHARD", "H_SPEED_BATCH", "H_SPEED_SEQ"]
+
+# record headers stamped on every checkpointed UP publish: which worker
+# published it, in which micro-batch, at which position — a durable
+# per-worker record identity the recovery scan dedups against
+H_SPEED_SHARD = "speed-shard"
+H_SPEED_BATCH = "speed-batch"
+H_SPEED_SEQ = "speed-seq"
+
+
+def stamp_headers(base: dict, shard_tag: str, batch: int,
+                  seq: int) -> dict:
+    """The publish headers for one staged update: the batch's base
+    headers (``ts``, maybe ``traceparent``) plus the worker/batch/seq
+    identity recovery dedups on."""
+    h = dict(base)
+    h[H_SPEED_SHARD] = shard_tag
+    h[H_SPEED_BATCH] = str(batch)
+    h[H_SPEED_SEQ] = str(seq)
+    return h
+
+
+class SpeedCheckpoint:
+    """One speed worker's durable state, a single atomically-written
+    JSON document (tmp + rename, the MirrorCheckpoint shape).  Keeping
+    the staged batch INSIDE the same document removes every two-file
+    ordering window: a load sees either the batch staged (crash before
+    commit — recovery resolves it) or committed, never half of each."""
+
+    FILE = "speed-checkpoint.json"
+
+    def __init__(self, checkpoint_dir: str):
+        store.mkdirs(checkpoint_dir)
+        self.path = store.join(checkpoint_dir, self.FILE)
+        self.input: dict[int, int] = {}
+        self.dest_scanned: dict[int, int] = {}
+        self.next_batch = 0
+        self.pending: dict | None = None
+        self.load()
+
+    def load(self) -> None:
+        if not store.exists(self.path):
+            return
+        try:
+            with store.open_read(self.path, "rb") as f:
+                doc = json.loads(f.read().decode("utf-8"))
+        except (OSError, ValueError):
+            _log.warning("Unreadable speed checkpoint at %s; the worker "
+                         "restarts from group offsets with no pending "
+                         "batch", self.path, exc_info=True)
+            return
+        self.input = {int(k): int(v)
+                      for k, v in (doc.get("input") or {}).items()}
+        self.dest_scanned = {int(k): int(v) for k, v
+                             in (doc.get("dest_scanned") or {}).items()}
+        self.next_batch = int(doc.get("next_batch", 0))
+        pending = doc.get("pending")
+        self.pending = pending if isinstance(pending, dict) else None
+
+    def save(self) -> None:
+        doc = {
+            "input": {str(k): v for k, v in self.input.items()},
+            "dest_scanned": {str(k): v
+                             for k, v in self.dest_scanned.items()},
+            "next_batch": self.next_batch,
+            "pending": self.pending,
+        }
+        tmp = self.path + ".tmp"
+        with store.open_write(tmp, "wb") as f:
+            f.write(json.dumps(doc, sort_keys=True).encode("utf-8"))
+        store.rename(tmp, self.path)
+
+    # -- the micro-batch protocol -------------------------------------------
+
+    def stage_batch(self, ends: Sequence[int], updates: Sequence[str],
+                    headers: dict) -> int:
+        """Durably stage a derived micro-batch BEFORE its first publish:
+        the write-ahead intent recovery replays byte-exactly.  Returns
+        the batch id the publishes must stamp."""
+        batch = self.next_batch
+        self.pending = {"batch": batch, "ends": [int(e) for e in ends],
+                        "headers": dict(headers),
+                        "updates": list(updates)}
+        self.save()
+        return batch
+
+    def commit_batch(self, ends: Sequence[int],
+                     dest_ends: Sequence[int] | None = None) -> None:
+        """The batch's publishes are all in the destination log: advance
+        the input fence past it, retire the staged intent, and (best
+        effort) mark the destination head so the next recovery scan is
+        incremental.  One atomic write."""
+        self.input = {i: int(e) for i, e in enumerate(ends)}
+        self.next_batch += 1
+        self.pending = None
+        if dest_ends is not None:
+            for p, e in enumerate(dest_ends):
+                if e is None:
+                    continue
+                self.dest_scanned[p] = max(self.dest_scanned.get(p, 0),
+                                           int(e))
+        self.save()
+
+
+def recover_pending(checkpoint: SpeedCheckpoint, shard_tag: str,
+                    read_dest: Callable[[list[int], list[int]], Iterable],
+                    dest_ends: Sequence[int],
+                    publish: Callable[[str, dict], None]
+                    ) -> tuple[int, int]:
+    """Resolve a staged-but-uncommitted micro-batch against the
+    destination log.
+
+    ``read_dest(starts, ends)`` yields the destination records (objects
+    with ``.headers``) in ``[starts, ends)``; ``publish(message,
+    headers)`` appends one update.  Returns ``(republished, deduped)``:
+    how many staged sequences were missing from the log and re-sent
+    byte-exactly, and how many were found already durable and skipped.
+    No-op ``(0, 0)`` when nothing is pending.  Idempotent: a crash
+    anywhere inside leaves the stage in place and a re-run converges.
+    """
+    pending = checkpoint.pending
+    if pending is None:
+        return 0, 0
+    batch = int(pending["batch"])
+    updates = list(pending.get("updates") or [])
+    base = dict(pending.get("headers") or {})
+    starts = [checkpoint.dest_scanned.get(p, 0)
+              for p in range(len(dest_ends))]
+    found: set[int] = set()
+    for km in read_dest(starts, [int(e) for e in dest_ends]):
+        h = getattr(km, "headers", None) or {}
+        if h.get(H_SPEED_SHARD) != shard_tag:
+            continue
+        try:
+            if int(h.get(H_SPEED_BATCH)) != batch:
+                continue
+            found.add(int(h.get(H_SPEED_SEQ)))
+        except (TypeError, ValueError):
+            continue
+    republished = 0
+    for seq, update in enumerate(updates):
+        if seq in found:
+            continue  # the durable log already holds it: dedup, don't double-fold
+        publish(update, stamp_headers(base, shard_tag, batch, seq))
+        republished += 1
+    if republished or found:
+        _log.info("Speed recovery (%s batch %d): %d already durable, "
+                  "%d republished from the staged bytes", shard_tag,
+                  batch, len(found), republished)
+    # dest_ends predates our republishes, so advancing the scan mark to
+    # it can never hide a record a FUTURE recovery would need: scans
+    # only ever look for the (single) pending batch, and this one is
+    # committed on the next line
+    checkpoint.commit_batch(pending.get("ends") or [], dest_ends=dest_ends)
+    return republished, len(found)

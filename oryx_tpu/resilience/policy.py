@@ -489,6 +489,32 @@ class ResilientTopicProducer:
             self._breaker.call(self._retry.call, self._inner.send,
                                key, message, **kw)
 
+    def send_many(self, entries: list[tuple[str | None, str,
+                                            dict | None]]) -> None:
+        """Pipelined multi-record send under ONE retry/breaker
+        admission: the whole batch is one logical produce, so a
+        mid-batch failure retries the batch (at-least-once — the
+        update-topic SET semantics and the speed checkpoint's dedup
+        scan absorb the duplicates).  Falls back to a per-record loop
+        for wrapped producers without ``send_many``."""
+        entries = list(entries)
+        if not entries:
+            return
+        send_many = getattr(self._inner, "send_many", None)
+        if send_many is not None:
+            fn, args = send_many, (entries,)
+        else:
+            fn, args = self._send_each, (entries,)
+        if self._breaker is None:
+            self._retry.call(fn, *args)
+        else:
+            self._breaker.call(self._retry.call, fn, *args)
+
+    def _send_each(self, entries) -> None:
+        for key, message, headers in entries:
+            kw = {} if headers is None else {"headers": headers}
+            self._inner.send(key, message, **kw)
+
     def get_update_broker(self) -> str:
         return self._inner.get_update_broker()
 

@@ -31,7 +31,7 @@ from ..common import text as text_utils
 from ..lambda_rt.http import Request, Route
 from ..ops import als_fold_in
 from . import console
-from .framework import get_serving_model, send_input
+from .framework import get_serving_model, send_input, send_input_many
 
 # IDValue/IDCount and the param/path parsing helpers are also the
 # cluster gateway's vocabulary (cluster/router.py re-serves this
@@ -472,8 +472,10 @@ def _ingest(req: Request):
         fields = text_utils.parse_input_line(line)
         if not 2 <= len(fields) <= 4:
             raise OryxServingException(400, f"bad line: {line}")
-    for line in lines:
-        send_input(req, line)
+    # one pipelined produce for the whole body (kafka send_many): a
+    # 200 means EVERY line is durable in the input topic
+    if lines:
+        send_input_many(req, lines)
     return {"ingested": len(lines)}
 
 

@@ -20,7 +20,8 @@ from ..obs.server import (admin_profile, admin_region, admin_slo,
                           admin_tail, admin_traces, prometheus_response)
 from ..resilience.policy import CircuitOpenError, resilience_snapshot
 
-__all__ = ["ROUTES", "get_serving_model", "send_input"]
+__all__ = ["ROUTES", "get_serving_model", "send_input",
+           "send_input_many"]
 
 
 def get_serving_model(req: Request) -> Any:
@@ -36,13 +37,25 @@ def get_serving_model(req: Request) -> Any:
 
 
 def send_input(req: Request, line: str) -> None:
+    send_input_many(req, [line])
+
+
+def send_input_many(req: Request, lines: list[str]) -> None:
+    """Durably append ``lines`` to the input topic — one pipelined
+    ``send_many`` produce, so a multi-line ``/ingest`` costs one broker
+    call instead of one per record.  A normal return means every
+    record is in the input topic (202 = durable); any failure maps to
+    503 (retry), never a partial silent loss.  The ingest admission
+    gate (serving/ingest.py) sheds HERE, inside the write path only,
+    so health/admin/read routes are never gated."""
     producer = req.context.get("input_producer")
     if producer is None:
         raise OryxServingException(403, "no input topic configured")
-    # record headers (kafka/api.py): `ts` stamps ingest wall-clock so
-    # the speed layer can measure ingest→servable freshness end to
-    # end; `traceparent` carries a sampled request's trace context so
-    # the fold-in that makes this record servable joins its trace
+    # record headers (kafka/api.py), preserved PER RECORD: `ts` stamps
+    # ingest wall-clock so the speed layer can measure ingest→servable
+    # freshness end to end; `traceparent` carries a sampled request's
+    # trace context so the fold-in that makes each record servable
+    # joins its trace
     headers = {"ts": str(int(clockmod.now() * 1000))}
     tracer = req.context.get("tracer")
     if tracer is not None:
@@ -52,9 +65,18 @@ def send_input(req: Request, line: str) -> None:
     # key = hash of the message, so identical records land in the same
     # partition (reference: AbstractOryxResource.sendInput :68 sends
     # Integer.toHexString(message.hashCode()) as the key)
+    entries = [(format(zlib.crc32(line.encode("utf-8")), "x"), line,
+                dict(headers)) for line in lines]
+    gate = req.context.get("ingest_gate")
     try:
-        producer.send(format(zlib.crc32(line.encode("utf-8")), "x"), line,
-                      headers=headers)
+        if gate is not None:
+            with gate.admitted(req.context.get("metrics"),
+                               n=len(entries)):
+                _produce(producer, entries)
+        else:
+            _produce(producer, entries)
+    except OryxServingException:
+        raise  # the gate's shed (503 + Retry-After) passes through
     except CircuitOpenError as e:
         # broker presumed down: degrade the write surface to fast 503s
         # (not 500 — the request was fine; the dependency is not) and
@@ -63,6 +85,19 @@ def send_input(req: Request, line: str) -> None:
     except Exception as e:  # noqa: BLE001 — any broker fault degrades,
         raise OryxServingException(                   # it doesn't error
             503, f"input send failed: {e}") from e
+
+
+def _produce(producer, entries: list[tuple[str, str, dict]]) -> None:
+    if len(entries) == 1:
+        key, line, headers = entries[0]
+        producer.send(key, line, headers=headers)
+        return
+    send_many = getattr(producer, "send_many", None)
+    if send_many is not None:
+        send_many(entries)
+        return
+    for key, line, headers in entries:
+        producer.send(key, line, headers=headers)
 
 
 def _ready(req: Request):

@@ -22,8 +22,10 @@ from collections import defaultdict
 from ..kafka import inproc
 from ..resilience import faults as prod_faults
 from .components import (INPUT_TOPIC, UPDATE_TOPIC, SimClient,
-                         SimMirror, SimReplica, SimRouter, SimSpeed)
-from .faults import FaultAction, arm_crash_mid_replay
+                         SimMirror, SimReplica, SimRouter, SimSpeed,
+                         SimSpeedShard)
+from .faults import (FaultAction, arm_crash_mid_batch,
+                     arm_crash_mid_replay)
 from .invariants import Checkers, InvariantViolation
 from .net import SimNet
 from .sched import Scheduler
@@ -53,6 +55,15 @@ class SimCluster:
         self.live: dict[str, object] = {}
         self.dead: set[str] = set()
         self._rec_seq: dict[str, int] = {}
+        # region -> shard count when the region runs the sharded
+        # crash-safe speed layer (SimSpeedShard) instead of SimSpeed
+        self.speed_sharded: dict[str, int] = {}
+        # every write the router ACKED (region, entity, rec): the
+        # ledger the exactly-once-fold invariant audits after drain
+        self.acked_writes: list[tuple[str, str, str]] = []
+        # region -> (capacity, window_sec) write-admission budget; on
+        # the cluster, not the router, so restarts keep the limit
+        self.ingest_limits: dict[str, tuple[int, float]] = {}
 
     # -- infrastructure -------------------------------------------------------
 
@@ -86,8 +97,12 @@ class SimCluster:
         self.sched.spawn(name, comp.run())
         return comp
 
-    def add_region(self, region: str) -> None:
-        """Broker + topics + router + speed layer for one region."""
+    def add_region(self, region: str, speed_shards: int = 1) -> None:
+        """Broker + topics + router + speed layer for one region.
+        ``speed_shards > 1`` runs the sharded crash-safe speed layer:
+        N :class:`SimSpeedShard` workers over the one input topic,
+        each folding only its item slice through the real durable
+        fence."""
         self.regions.append(region)
         b = inproc.get_broker(self.broker_name(region))
         b.create_topic(UPDATE_TOPIC, partitions=1)
@@ -95,8 +110,16 @@ class SimCluster:
         self._brokers[region] = b
         self._start(f"{region}.router",
                     lambda r=region: SimRouter(self, r))
-        self._start(f"{region}.speed",
-                    lambda r=region: SimSpeed(self, r))
+        if speed_shards > 1:
+            self.speed_sharded[region] = speed_shards
+            for s in range(speed_shards):
+                self._start(
+                    f"{region}.speed{speed_shards}x{s}",
+                    lambda r=region, i=s, n=speed_shards:
+                    SimSpeedShard(self, r, i, n))
+        else:
+            self._start(f"{region}.speed",
+                        lambda r=region: SimSpeed(self, r))
 
     def add_replica(self, region: str, shard: int, of: int,
                     idx: int) -> SimReplica:
@@ -166,10 +189,15 @@ class SimCluster:
         elif act.kind == "stall":
             self.sched.stall(act.a, float(act.arg))
         elif act.kind == "crash":
-            # arm the production mid-replay crash seam; the next
-            # mirror replay in the sim dies in the fence's window
+            # arm the matching production crash seam once; the next
+            # batch in the fence's window anywhere in the sim dies
+            # there (mirror: after sends, before checkpoint save;
+            # speed: after UP publishes, before the batch commit)
             if act.a in self.live:
-                arm_crash_mid_replay()
+                if ".speed" in act.a:
+                    arm_crash_mid_batch()
+                else:
+                    arm_crash_mid_replay()
         else:
             raise ValueError(f"unknown fault kind {act.kind!r}")
 
@@ -196,6 +224,11 @@ class SimCluster:
             speed = self.live.get(f"{r}.speed")
             if speed is not None and not speed.drained():
                 return False
+            n = self.speed_sharded.get(r, 0)
+            for s in range(n):
+                w = self.live.get(f"{r}.speed{n}x{s}")
+                if w is None or not w.drained():
+                    return False
         for rep in self.replicas():
             if not rep.drained():
                 return False

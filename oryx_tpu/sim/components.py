@@ -33,6 +33,7 @@ bytes to the mirror, exactly like production traffic.
 from __future__ import annotations
 
 import json
+import os
 
 from ..cluster.membership import (KEY_HEARTBEAT, Heartbeat,
                                   MembershipRegistry)
@@ -40,12 +41,16 @@ from ..cluster.mirror import MirrorLayer
 from ..cluster.sharding import shard_of
 from ..common.config import from_dict
 from ..kafka.api import KEY_MODEL, KEY_UP
+from ..lambda_rt.speed_checkpoint import (SpeedCheckpoint,
+                                          recover_pending,
+                                          stamp_headers)
+from ..resilience import faults as prod_faults
 from ..resilience.faults import InjectedCrash
 from .net import NetError
 from .sched import Sleep, Step, gather
 
 __all__ = ["UPDATE_TOPIC", "INPUT_TOPIC", "SimReplica", "SimRouter",
-           "SimSpeed", "SimMirror", "SimClient"]
+           "SimSpeed", "SimSpeedShard", "SimMirror", "SimClient"]
 
 UPDATE_TOPIC = "OryxUpdate"
 INPUT_TOPIC = "SimIn"
@@ -187,6 +192,12 @@ class SimRouter:
         self.cache_hits = 0
         self.cache_stores = 0
         self._qn = 0
+        # sliding admission window for the write path (the ingest
+        # backpressure model); the LIMIT lives on the cluster so a
+        # restarted router keeps shedding, the window state is
+        # per-instance — a cold router starts with headroom, exactly
+        # like a real in-memory gate
+        self._write_times: list[float] = []
 
     def _tap(self) -> None:
         b = self.cx.broker(self.region)
@@ -223,10 +234,27 @@ class SimRouter:
     def handler(self, req):
         op = req.get("op")
         if op == "write":
+            limit = self.cx.ingest_limits.get(self.region)
+            if limit is not None:
+                cap, window = limit
+                now = self.cx.clock.monotonic()
+                self._write_times = [t for t in self._write_times
+                                     if now - t < window]
+                if len(self._write_times) >= cap:
+                    # shed BEFORE the durable append: a 503 carries
+                    # no record id, so "503 means retry, nothing was
+                    # acked" holds by construction
+                    self.cx.stats["ingest_sheds"] += 1
+                    return {"status": 503, "retry_after": 1}
+                self._write_times.append(now)
             e = req["e"]
             rec = self.cx.next_rec(self.region)
             self.cx.broker(self.region).send(
                 INPUT_TOPIC, e, _up_record(e, rec))
+            # the ack ledger the exactly-once-fold invariant audits:
+            # a 200 here is a durability promise the speed layer must
+            # honor through any crash
+            self.cx.acked_writes.append((self.region, e, rec))
             return {"status": 200, "rec": rec}
         if op == "query":
             return self._query(req)   # generator: async handler
@@ -326,6 +354,115 @@ class SimSpeed:
             b.set_offset(self.GROUP, INPUT_TOPIC, end, 0)
 
 
+class SimSpeedShard:
+    """One shard of the crash-safe sharded speed layer, around the
+    REAL :class:`SpeedCheckpoint` durable fence
+    (lambda_rt/speed_checkpoint.py).  Every worker consumes the full
+    input topic but folds only entities it owns per the real
+    ``shard_of``; each micro-batch is write-ahead staged, published
+    with shard/batch/seq headers, then committed in one atomic
+    document.  The sim decides WHEN the loop steps and when the
+    process dies — a kill between publishes, or the production
+    ``speed-crash-mid-batch`` seam (after the sends, before the
+    commit), lands in the fence's window; restart runs the real
+    ``recover_pending`` scan-and-dedup, so acked writes fold exactly
+    once no matter where the death landed."""
+
+    POLL = 0.05
+
+    def __init__(self, cx, region: str, shard: int, of: int):
+        self.cx = cx
+        self.region = region
+        self.shard = shard
+        self.of = of
+        self.name = f"{region}.speed{of}x{shard}"
+        self.tag = f"{shard}/{of}"
+        self.published = 0
+        self.dedup_skips = 0
+        self.checkpoint = SpeedCheckpoint(
+            os.path.join(cx.checkpoint_dir(region),
+                         f"speed{of}x{shard}"))
+        # the production restart path: resolve any staged-uncommitted
+        # batch against the destination log before the first poll
+        self._recover()
+
+    def _publish(self, message: str, headers: dict) -> None:
+        self.cx.broker(self.region).send(UPDATE_TOPIC, KEY_UP,
+                                         message, headers=headers)
+        self.published += 1
+
+    def _recover(self) -> None:
+        b = self.cx.broker(self.region)
+        dest_end = b.latest_offset(UPDATE_TOPIC)
+        republished, deduped = recover_pending(
+            self.checkpoint, self.tag,
+            lambda starts, ends: b.read_range(
+                UPDATE_TOPIC, starts[0], ends[0]),
+            [dest_end], self._publish)
+        self.dedup_skips += deduped
+        if republished or deduped:
+            self.cx.sched.note(
+                f"speed.recovered|{self.name}|{republished}|{deduped}")
+        self.cx.checkers.on_speed_checkpoint(self)
+
+    def drained(self) -> bool:
+        b = self.cx.broker(self.region)
+        return (self.checkpoint.pending is None
+                and self.checkpoint.input.get(0, 0)
+                >= b.latest_offset(INPUT_TOPIC))
+
+    def run(self):
+        b = self.cx.broker(self.region)
+        try:
+            while True:
+                yield Sleep(self.POLL)
+                if self.checkpoint.pending is not None:
+                    # a publish attempt died mid-batch: finish it from
+                    # the staged bytes before deriving anything new
+                    self._recover()
+                    continue
+                start = self.checkpoint.input.get(0, 0)
+                end = b.latest_offset(INPUT_TOPIC)
+                if start >= end:
+                    continue
+                updates = []
+                for km in b.read_range(INPUT_TOPIC, start, end):
+                    try:
+                        e = json.loads(km.message)["e"]
+                    except (ValueError, KeyError, TypeError):
+                        continue
+                    if shard_of(e, self.of) == self.shard:
+                        updates.append(km.message)
+                if not updates:
+                    # nothing owned in this slice: just advance the
+                    # input fence (other shards own those records)
+                    self.checkpoint.commit_batch([end])
+                    self.cx.checkers.on_speed_checkpoint(self)
+                    continue
+                base = {"ts": str(int(self.cx.clock.time() * 1000))}
+                batch = self.checkpoint.stage_batch([end], updates,
+                                                    base)
+                for seq, msg in enumerate(updates):
+                    self._publish(msg, stamp_headers(base, self.tag,
+                                                     batch, seq))
+                    # the crash window: some publishes durable, the
+                    # staged batch still uncommitted.  A Sleep (not a
+                    # bare Step) so the window spans virtual time and
+                    # kill faults can land INSIDE it — forcing the
+                    # republish-missing-seqs recovery path, not just
+                    # the dedup-all one
+                    yield Sleep(0.004)
+                # the production seam: die after the sends, before
+                # the atomic commit
+                prod_faults.fire("speed-crash-mid-batch")
+                self.checkpoint.commit_batch(
+                    [end], dest_ends=[b.latest_offset(UPDATE_TOPIC)])
+                self.cx.checkers.on_speed_checkpoint(self)
+        except InjectedCrash:
+            self.cx.sched.note(f"speed.crashed|{self.name}")
+            self.cx.on_component_crashed(self.name)
+
+
 class SimMirror:
     """A real :class:`MirrorLayer` driven cooperatively.  The
     replication link to the remote region's broker is subject to the
@@ -420,7 +557,12 @@ class SimClient:
                 st["client_net_errors"] += 1
                 continue
             if req["op"] == "write":
-                st["writes_ok"] += 1
+                if resp.get("status") == 503:
+                    # shed by the ingest admission window: retryable,
+                    # explicitly NOT acked — no durability promise
+                    st["writes_shed"] += 1
+                else:
+                    st["writes_ok"] += 1
             else:
                 st["queries_ok"] += 1
                 if resp.get("partial"):

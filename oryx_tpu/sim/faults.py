@@ -21,10 +21,12 @@ Actions (``kind``):
 - ``stall`` — freeze one component for a duration (GC/VM pause): it
   stays "alive" (its heartbeats just stop flowing) but takes no
   steps.
-- ``crash`` — arm the production ``mirror-crash-mid-replay`` fault
-  point (resilience/faults.py) once: the next mirror poll that
-  replays a record dies AFTER its sends, BEFORE its checkpoint save —
-  the exact window the exactly-once fence exists for.
+- ``crash`` — arm the production crash seam matching the named
+  component once (resilience/faults.py): a mirror dies at
+  ``mirror-crash-mid-replay`` (after its sends, before its checkpoint
+  save); a speed worker dies at ``speed-crash-mid-batch`` (after its
+  UP publishes, before its batch commit) — in each case the exact
+  window the exactly-once fence exists for.
 
 ``random_schedule`` derives a schedule from the scenario's RNG — the
 same seeded stream the scheduler picks tasks with — so seed → faults
@@ -132,6 +134,15 @@ def arm_crash_mid_replay() -> None:
     docstring); the next mirror replay anywhere in the sim dies in
     the fence's window."""
     prod_faults.inject("mirror-crash-mid-replay", mode="crash",
+                       times=1)
+
+
+def arm_crash_mid_batch() -> None:
+    """Arm the production speed fold-in crash seam once: the next
+    speed micro-batch anywhere in the sim dies AFTER its UP
+    publishes, BEFORE its checkpoint commit — the window the
+    SpeedCheckpoint fence's replay dedup exists for."""
+    prod_faults.inject("speed-crash-mid-batch", mode="crash",
                        times=1)
 
 

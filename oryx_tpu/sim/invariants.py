@@ -33,6 +33,15 @@ hash and the one-line repro command.
    the same record ids per entity, with identical message bytes per
    record id; and every caught-up replica's applied state equals the
    state derived independently from its region's log.
+6. **speed checkpoint never-rewind** — a sharded speed worker's
+   input fence, destination scan mark and batch counter only ever
+   advance, across polls AND across crash/recover cycles.
+7. **acked writes fold exactly once, on the owner shard** — in a
+   region running the sharded speed layer, every write the router
+   ACKED appears EXACTLY once among the update topic's
+   speed-stamped UP records after drain (zero lost through any
+   crash, zero double-folds through any replay), and the stamping
+   worker is the entity's owner under the real ``shard_of``.
 """
 
 from __future__ import annotations
@@ -42,6 +51,7 @@ import json
 from ..cluster.mirror import H_ORIGIN_REGION, origin_of
 from ..cluster.sharding import shard_of
 from ..kafka.api import KEY_UP
+from ..lambda_rt.speed_checkpoint import H_SPEED_SHARD
 from .components import UPDATE_TOPIC
 
 __all__ = ["InvariantViolation", "Checkers"]
@@ -103,6 +113,7 @@ class Checkers:
         self.responses_checked = 0
         self.cache_hits_checked = 0
         self.mirror_polls_checked = 0
+        self.speed_checkpoints_checked = 0
 
     # -- request-path invariants (1, 2) ---------------------------------------
 
@@ -158,6 +169,22 @@ class Checkers:
         for p, off in ck.dest_scanned.items():
             self._advance_only(name, "scan", p, off)
 
+    # -- speed-layer invariants (6) -------------------------------------------
+
+    def on_speed_checkpoint(self, sim_speed):
+        """Called by a sharded speed worker after every checkpoint
+        transition (stage resolution or batch commit): the durable
+        fence's marks must never rewind, across crash/recover cycles
+        (keyed by worker name, not instance)."""
+        self.speed_checkpoints_checked += 1
+        ck = sim_speed.checkpoint
+        name = sim_speed.name
+        for p, off in ck.input.items():
+            self._advance_only(name, "input", p, off)
+        for p, off in ck.dest_scanned.items():
+            self._advance_only(name, "dest-scan", p, off)
+        self._advance_only(name, "batch", 0, ck.next_batch)
+
     def _advance_only(self, name: str, kind: str, key, value: int):
         k = (name, kind, key)
         prev = self._ckpt_max.get(k, -1)
@@ -206,10 +233,61 @@ class Checkers:
                     "convergence",
                     f"replica {rep.name} applied state diverges from "
                     f"its region log on entities {sorted(diff)}")
+        folds_checked = self._check_speed_folds()
         return {
             "entities": sum(len(s[0]) for s in states.values()),
             "records": sum(len(s[1]) for s in states.values()),
             "responses_checked": self.responses_checked,
             "cache_hits_checked": self.cache_hits_checked,
             "mirror_polls_checked": self.mirror_polls_checked,
+            "speed_folds_checked": folds_checked,
         }
+
+    def _check_speed_folds(self) -> int:
+        """Terminal invariant 7: in every sharded-speed region, each
+        ACKED write appears exactly once among the speed-stamped UP
+        records, published by the entity's owner shard — recomputed
+        straight from the ack ledger and the raw log, never from the
+        workers' own counters."""
+        checked = 0
+        for region, of in self.cx.speed_sharded.items():
+            b = self.cx.broker(region)
+            end = b.latest_offset(UPDATE_TOPIC)
+            folded: dict[str, list[tuple[str, str]]] = {}
+            for km in b.read_range(UPDATE_TOPIC, 0, end):
+                if km.key != KEY_UP:
+                    continue
+                tag = (km.headers or {}).get(H_SPEED_SHARD)
+                if tag is None:
+                    continue
+                try:
+                    doc = json.loads(km.message)
+                    e, rec = doc["e"], doc["rec"]
+                except (ValueError, KeyError, TypeError):
+                    continue
+                folded.setdefault(rec, []).append((e, tag))
+            for r, e, rec in self.cx.acked_writes:
+                if r != region:
+                    continue
+                checked += 1
+                hits = folded.get(rec, [])
+                if not hits:
+                    raise InvariantViolation(
+                        "speed-exactly-once",
+                        f"acked write {rec} (entity {e}) never folded "
+                        f"into region {region}'s update log — a 200 "
+                        f"was a durability promise")
+                if len(hits) > 1:
+                    raise InvariantViolation(
+                        "speed-exactly-once",
+                        f"acked write {rec} (entity {e}) folded "
+                        f"{len(hits)}x into region {region} "
+                        f"(double-fold past the dedup fence)")
+                owner = f"{shard_of(e, of)}/{of}"
+                if hits[0][1] != owner:
+                    raise InvariantViolation(
+                        "speed-exactly-once",
+                        f"write {rec} (entity {e}) folded by shard "
+                        f"{hits[0][1]}, but the owner under of={of} "
+                        f"is {owner}")
+        return checked

@@ -243,9 +243,137 @@ def run_reshard_cutover(seed: int, old_of: int = 2,
     return _run("reshard-cutover", seed, keep_trace, body)
 
 
+# -- scenario: sharded speed layer crash / recover ---------------------------
+
+def run_speed_shard_crash(seed: int, speed_shards: int = 2,
+                          shards: int = 2, per_shard: int = 1,
+                          ops: int = 34, horizon: float = 6.0,
+                          keep_trace: bool = False) -> SimResult:
+    """Single region running the sharded crash-safe speed layer under
+    continuous client load.  Every seed kills one speed worker — via a
+    raw process kill (landing anywhere, including between a batch's
+    publishes) or the production ``speed-crash-mid-batch`` seam (after
+    every publish, before the commit) — and restarts it through the
+    real ``recover_pending`` fence, with extra seeded chaos on top.
+    After quiesce, every ACKED write must appear exactly once in the
+    update log, stamped by its owner shard: zero lost, zero
+    double-folded, through any interleaving."""
+
+    def body(cx: SimCluster):
+        rng = cx.rng
+        cx.add_region("A", speed_shards=speed_shards)
+        cx.add_replica_fleet("A", shards, per_shard)
+        cx.publish_model("A")
+        cx.add_client("A", 0, ops, ENTITIES)
+        speeds = [f"A.speed{speed_shards}x{s}"
+                  for s in range(speed_shards)]
+        # every seed downs at least one speed worker; which one, when,
+        # and whether it is a kill or the mid-batch crash seam is the
+        # seed's choice
+        victim = speeds[rng.randrange(len(speeds))]
+        # inside the client's write window, so an armed mid-batch
+        # seam has live batches to land in before quiesce
+        t = rng.uniform(0.3, 1.4)
+        kind = "crash" if rng.random() < 0.6 else "kill"
+        forced = [FaultAction(t, kind, victim),
+                  FaultAction(t + rng.uniform(0.3, 1.5), "restart",
+                              victim)]
+        components = ([f"A.rep{shards}x{s}.{i}"
+                       for s in range(shards)
+                       for i in range(per_shard)]
+                      + speeds + ["A.router"])
+        links = [("A.router", "A.rep"), ("A.client0", "A.router")]
+        extra = random_schedule(
+            rng, horizon, n=1 + rng.randrange(3),
+            components=components, links=links, crashable=speeds)
+        sched = FaultSchedule(forced + extra.actions)
+        cx.sched.spawn("fault-driver", sched.driver(cx))
+        cx.sched.run_until(horizon)
+        cx.quiesce()
+
+    return _run("speed-shard-crash", seed, keep_trace, body)
+
+
+# -- scenario: ingest overload / backpressure --------------------------------
+
+def _burst_writer(cx: SimCluster, region: str, n: int,
+                  start_at: float):
+    """A hot producer: back-to-back writes far past the region's
+    admission budget.  Sheds are expected and retryable; what must
+    NEVER happen is a 200 whose record the pipeline then loses — the
+    terminal fold invariant audits exactly that."""
+    from .net import NetError
+    yield Sleep(start_at)
+    st = cx.stats
+    for i in range(n):
+        yield Sleep(0.02)
+        try:
+            resp = yield from cx.net.call(
+                f"{region}.burst", f"{region}.router",
+                {"op": "write",
+                 "e": ENTITIES[i % len(ENTITIES)]},
+                timeout=1.2)
+        except NetError:
+            st["burst_errors"] += 1
+            continue
+        if resp.get("status") == 503:
+            st["burst_sheds"] += 1
+        else:
+            st["burst_ok"] += 1
+
+
+def run_ingest_overload(seed: int, speed_shards: int = 2,
+                        shards: int = 2, per_shard: int = 1,
+                        ops: int = 16, horizon: float = 6.0,
+                        keep_trace: bool = False) -> SimResult:
+    """A write burst against a region whose router admits at most
+    ``cap`` writes per sliding window, over the sharded speed layer
+    with seeded crash chaos.  The backpressure contract under test:
+    overload produces explicit 503 sheds (never queue collapse), a
+    shed is never an ack, and every 200 that WAS returned survives
+    the overload + crashes to exactly one fold on its owner shard."""
+
+    def body(cx: SimCluster):
+        rng = cx.rng
+        cx.add_region("A", speed_shards=speed_shards)
+        cx.add_replica_fleet("A", shards, per_shard)
+        cx.publish_model("A")
+        # the admission budget lives on the cluster, so a restarted
+        # router keeps shedding
+        cap = 3 + rng.randrange(3)
+        cx.ingest_limits["A"] = (cap, 1.5)
+        cx.add_client("A", 0, ops, ENTITIES)
+        burst_n = 18 + rng.randrange(8)
+        cx.sched.spawn(
+            "A.burst",
+            _burst_writer(cx, "A", burst_n,
+                          rng.uniform(0.3, 1.0)))
+        speeds = [f"A.speed{speed_shards}x{s}"
+                  for s in range(speed_shards)]
+        # chaos on the fold path only: the router must stay up so the
+        # burst exercises admission, not unreachability
+        extra = random_schedule(
+            rng, horizon, n=1 + rng.randrange(3),
+            components=speeds, links=[("A.client0", "A.router")],
+            crashable=speeds,
+            allow=("kill", "crash", "delay", "stall"))
+        cx.sched.spawn("fault-driver", extra.driver(cx))
+        cx.sched.run_until(horizon)
+        cx.quiesce()
+        if cx.stats.get("ingest_sheds", 0) < 1:
+            raise InvariantViolation(
+                "backpressure",
+                f"a burst of {burst_n} writes against an admission "
+                f"budget of {cap}/1.5s produced zero sheds")
+
+    return _run("ingest-overload", seed, keep_trace, body)
+
+
 SCENARIOS = {
     "mirror-partition": run_mirror_partition,
     "reshard-cutover": run_reshard_cutover,
+    "speed-shard-crash": run_speed_shard_crash,
+    "ingest-overload": run_ingest_overload,
 }
 
 

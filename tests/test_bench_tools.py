@@ -495,6 +495,73 @@ def test_check_regression_gateway_conns_cell_back_compat(tmp_path,
     assert not report["regressions"]
 
 
+def test_check_regression_gateway_writes_cell_gates_independently(
+        tmp_path, capsys):
+    """The r15 write-heavy rung (durable-ack ingest, ISSUE 17) gates
+    as its own pseudo-cell on sustained ACKED writes/s: a write-path
+    regression — gate, pipelined produce, broker append — fails the
+    gate even when the read cell held; the acked==durable ledger and
+    fold-in freshness ride along for diagnosis."""
+    prev = _gateway_doc([(50, 65536, 1, 100.0)])
+    prev["rows"][0]["writes"] = {
+        "open_loop_sustained_qps": 1200.0,
+        "acked_equals_durable": True,
+        "ingest_to_servable_ms": 700.0,
+        "overload": {"p50_shed_ms": 1.5}}
+    cur = _gateway_doc([(50, 65536, 1, 101.0)])
+    cur["rows"][0]["writes"] = {
+        "open_loop_sustained_qps": 500.0,
+        "acked_equals_durable": True,
+        "ingest_to_servable_ms": 2400.0,
+        "overload": {"p50_shed_ms": 1.4}}
+    rc = cr.main(["--kind", "gateway",
+                  "--previous", _write(tmp_path,
+                                       "BENCH_GATEWAY_r14.json", prev),
+                  "--current", _write(tmp_path,
+                                      "BENCH_GATEWAY_r15.json", cur)])
+    assert rc == 1
+    report = json.loads(capsys.readouterr().out)
+    assert [c["cell"] for c in report["regressions"]] == \
+        ["50f/0.065536M/1rep/writes"]
+    # no rung sustained (errors or sheds on every rung) zeroes the
+    # gated number: also a failure
+    cur["rows"][0]["writes"]["open_loop_sustained_qps"] = 0.0
+    rc = cr.main(["--kind", "gateway",
+                  "--previous", _write(tmp_path,
+                                       "BENCH_GATEWAY_r14.json", prev),
+                  "--current", _write(tmp_path,
+                                      "BENCH_GATEWAY_r15.json", cur)])
+    assert rc == 1
+    # and a healthy rung gates green
+    cur["rows"][0]["writes"]["open_loop_sustained_qps"] = 1180.0
+    rc = cr.main(["--kind", "gateway",
+                  "--previous", _write(tmp_path,
+                                       "BENCH_GATEWAY_r14.json", prev),
+                  "--current", _write(tmp_path,
+                                      "BENCH_GATEWAY_r15.json", cur)])
+    assert rc == 0
+
+
+def test_check_regression_gateway_writes_cell_back_compat(tmp_path,
+                                                          capsys):
+    """r14-and-earlier artifacts carry no write rung: the pseudo-cell
+    is reported as new, never gated against them."""
+    prev = _gateway_doc([(50, 65536, 1, 100.0)])           # r14 shape
+    cur = _gateway_doc([(50, 65536, 1, 99.0)])
+    cur["rows"][0]["writes"] = {
+        "open_loop_sustained_qps": 1200.0,
+        "acked_equals_durable": True}
+    rc = cr.main(["--kind", "gateway",
+                  "--previous", _write(tmp_path,
+                                       "BENCH_GATEWAY_r14.json", prev),
+                  "--current", _write(tmp_path,
+                                      "BENCH_GATEWAY_r15.json", cur)])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["new_cells"] == ["(50, 65536, 1, 1, 'writes')"]
+    assert not report["regressions"]
+
+
 def test_check_regression_gateway_discovers_rounds_and_skips_cross_backend(
         tmp_path, capsys):
     _write(tmp_path, "BENCH_GATEWAY_r07.json",

@@ -1,0 +1,300 @@
+"""Write-path admission + pipelined batch produce (ISSUE 17).
+
+The durable-ack contract under test: a ``202`` from ``/ingest`` or
+``/pref`` means every record of the body is durable in the input
+topic; a ``503`` (shed, breaker, broker fault) means retry and NOTHING
+was silently half-written.  Two mechanisms carry it:
+
+- :class:`IngestGate` (serving/ingest.py): bounded in-flight sends +
+  measured-lag shedding, fast 503 + ``Retry-After``, ``ingest_sheds``
+  counter — wrapping ONLY the produce, never health/admin/reads;
+- ``send_many`` pipelining (kafka/inproc.py, resilience/policy.py):
+  a multi-line body is ONE broker call, classified per record through
+  the ``inproc-send`` chaos point BEFORE any append, so a mid-batch
+  fault retries the whole batch and never splits it.
+"""
+
+import threading
+from types import SimpleNamespace
+
+import pytest
+
+from oryx_tpu.api.serving import OryxServingException
+from oryx_tpu.common.config import from_dict
+from oryx_tpu.kafka.inproc import InProcTopicProducer, get_broker
+from oryx_tpu.lambda_rt.metrics import MetricsRegistry
+from oryx_tpu.resilience import faults
+from oryx_tpu.resilience.policy import (CircuitOpenError,
+                                        ResilientTopicProducer, Retry)
+from oryx_tpu.serving.framework import send_input, send_input_many
+from oryx_tpu.serving.ingest import IngestGate
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _gate(**extra) -> IngestGate:
+    return IngestGate(from_dict(
+        {f"oryx.serving.ingest.{k}": v for k, v in extra.items()}))
+
+
+# -- the admission gate ------------------------------------------------------
+
+def test_gate_disabled_by_default():
+    g = _gate()
+    assert not g.enabled  # both gates ship 0 = off
+
+
+def test_inflight_cap_sheds_fast_503_with_retry_after():
+    g = _gate(**{"max-inflight-sends": 1, "retry-after-sec": 7})
+    metrics = MetricsRegistry()
+    adm = g.admitted(metrics)          # slot taken, send "in flight"
+    with pytest.raises(OryxServingException) as ei:
+        g.admitted(metrics)
+    assert ei.value.status == 503
+    assert ei.value.headers == {"Retry-After": "7"}
+    assert g.sheds == 1
+    assert metrics.counters_snapshot()["ingest_sheds"] == 1
+    with adm:                          # the admitted send completes
+        pass
+    with g.admitted(metrics):          # slot free again: admitted
+        pass
+    assert g.sheds == 1
+
+
+def test_measured_lag_ewma_sheds_and_recovers(monkeypatch):
+    from oryx_tpu.serving import ingest as ingest_mod
+    t = [0.0]
+    monkeypatch.setattr(ingest_mod.clockmod, "monotonic",
+                        lambda: t[0])
+    g = _gate(**{"send-lag-high-ms": 50})
+
+    def send_taking(sec):
+        with g.admitted():
+            t[0] += sec
+
+    for _ in range(4):
+        send_taking(0.200)             # broker demonstrably slow
+    assert g.send_lag_ms() > 50
+    # lag high AND a send in flight = a convoy to join: shed
+    hold = g.admitted()
+    with pytest.raises(OryxServingException) as ei:
+        g.admitted()
+    assert ei.value.status == 503
+    with hold:
+        t[0] += 0.2
+    # with nothing in flight there is no convoy; requests are admitted
+    # as probes whose measurements drain the EWMA and reopen the gate
+    assert g.inflight == 0
+    for _ in range(20):
+        send_taking(0.001)
+    assert g.send_lag_ms() < 50
+    with g.admitted():
+        pass
+
+
+def test_admission_releases_on_produce_failure():
+    g = _gate(**{"max-inflight-sends": 2})
+    with pytest.raises(RuntimeError):
+        with g.admitted():
+            raise RuntimeError("broker went away mid-send")
+    assert g.inflight == 0             # a failed send must not leak a slot
+
+
+# -- send_input_many: the batched write surface ------------------------------
+
+class _CapturingProducer:
+    def __init__(self):
+        self.send_calls = []
+        self.send_many_calls = []
+
+    def send(self, key, message, headers=None):
+        self.send_calls.append((key, message, headers))
+
+    def send_many(self, entries):
+        self.send_many_calls.append(list(entries))
+
+
+def _req(producer, **ctx):
+    return SimpleNamespace(context={"input_producer": producer, **ctx})
+
+
+def test_multi_line_body_is_one_pipelined_produce():
+    p = _CapturingProducer()
+    send_input_many(_req(p), ["a,b,1", "c,d,2", "e,f,3"])
+    assert not p.send_calls
+    assert len(p.send_many_calls) == 1
+    entries = p.send_many_calls[0]
+    assert [m for _, m, _ in entries] == ["a,b,1", "c,d,2", "e,f,3"]
+    # headers are preserved PER RECORD: distinct dicts, each stamped
+    # with the ingest wall-clock ts the speed layer measures from
+    for _, _, h in entries:
+        assert h["ts"].isdigit()
+    assert len({id(h) for _, _, h in entries}) == len(entries)
+
+
+def test_single_line_uses_plain_send():
+    p = _CapturingProducer()
+    send_input(_req(p), "a,b,1")
+    assert len(p.send_calls) == 1 and not p.send_many_calls
+
+
+def test_no_producer_is_403():
+    with pytest.raises(OryxServingException) as ei:
+        send_input(_req(None), "a,b,1")
+    assert ei.value.status == 403
+
+
+def test_gate_shed_passes_through_before_any_append():
+    p = _CapturingProducer()
+    g = _gate(**{"max-inflight-sends": 1})
+    hold = g.admitted()                # the one slot is taken
+    with pytest.raises(OryxServingException) as ei:
+        send_input_many(_req(p, ingest_gate=g), ["a,b,1", "c,d,2"])
+    assert ei.value.status == 503
+    assert ei.value.headers["Retry-After"]
+    assert not p.send_calls and not p.send_many_calls, \
+        "a shed request must not half-produce its body"
+    with hold:
+        pass
+
+
+class _FailingProducer:
+    def __init__(self, exc):
+        self.exc = exc
+
+    def send(self, key, message, headers=None):
+        raise self.exc
+
+    def send_many(self, entries):
+        raise self.exc
+
+
+def test_breaker_open_and_broker_fault_both_map_to_503():
+    for exc, frag in ((CircuitOpenError("input-producer open"),
+                       "input unavailable"),
+                      (OSError("wire torn"), "input send failed")):
+        with pytest.raises(OryxServingException) as ei:
+            send_input_many(_req(_FailingProducer(exc)), ["x", "y"])
+        assert ei.value.status == 503
+        assert frag in str(ei.value)
+
+
+# -- the pipelined append under injected broker faults -----------------------
+
+def _resilient(broker_name, topic):
+    cfg = from_dict({
+        "oryx.resilience.retry.max-attempts": 3,
+        "oryx.resilience.retry.initial-backoff-ms": 1,
+        "oryx.resilience.retry.max-backoff-ms": 2,
+    })
+    return ResilientTopicProducer(
+        InProcTopicProducer(f"memory://{broker_name}", topic),
+        retry=Retry.from_config("test-ingest", cfg))
+
+
+def _messages(broker, topic):
+    end = broker.latest_offset(topic)
+    return [(km.key, km.message, km.headers)
+            for km in broker.read_range(topic, 0, end)]
+
+
+ENTRIES = [("k1", "m1", {"ts": "1"}), ("k2", "m2", {"ts": "2"}),
+           ("k3", "m3", {"ts": "3"})]
+
+
+def test_send_many_transient_fault_retries_whole_batch_exactly_once():
+    broker = get_broker("ingest-retry")
+    broker.create_topic("In", partitions=1)
+    prod = _resilient("ingest-retry", "In")
+    # the fault classifies records BEFORE any append: attempt 1 dies
+    # with zero records durable, the retry lands all three once
+    faults.inject("inproc-send", mode="error", times=1)
+    prod.send_many(list(ENTRIES))
+    assert _messages(broker, "In") == list(ENTRIES)
+
+
+def test_send_many_duplicate_delivery_is_at_least_once():
+    broker = get_broker("ingest-dup")
+    broker.create_topic("In", partitions=1)
+    prod = _resilient("ingest-dup", "In")
+    faults.inject("inproc-send", mode="duplicate", times=1)
+    prod.send_many(list(ENTRIES))
+    msgs = [m for _, m, _ in _messages(broker, "In")]
+    assert sorted(msgs) == ["m1", "m1", "m2", "m3"]
+
+
+def test_send_many_drop_loses_only_the_dropped_record():
+    broker = get_broker("ingest-drop")
+    broker.create_topic("In", partitions=1)
+    prod = _resilient("ingest-drop", "In")
+    faults.inject("inproc-send", mode="drop", times=1)
+    prod.send_many(list(ENTRIES))
+    msgs = [m for _, m, _ in _messages(broker, "In")]
+    assert msgs == ["m2", "m3"]
+
+
+def test_send_many_preserves_per_record_headers_and_order():
+    broker = get_broker("ingest-hdrs")
+    broker.create_topic("In", partitions=1)
+    prod = _resilient("ingest-hdrs", "In")
+    prod.send_many(list(ENTRIES))
+    got = _messages(broker, "In")
+    assert got == list(ENTRIES)
+    assert [h["ts"] for _, _, h in got] == ["1", "2", "3"]
+
+
+class _NoBatchProducer:
+    """Inner producer without send_many: the resilient wrapper must
+    fall back to a per-record loop under the same retry admission."""
+
+    def __init__(self):
+        self.sent = []
+        self.fail_first = True
+
+    def send(self, key, message, headers=None):
+        if self.fail_first:
+            self.fail_first = False
+            raise OSError("transient")
+        self.sent.append((key, message, headers))
+
+
+def test_send_many_falls_back_to_per_record_loop():
+    inner = _NoBatchProducer()
+    cfg = from_dict({
+        "oryx.resilience.retry.max-attempts": 3,
+        "oryx.resilience.retry.initial-backoff-ms": 1,
+        "oryx.resilience.retry.max-backoff-ms": 2,
+    })
+    prod = ResilientTopicProducer(inner,
+                                  retry=Retry.from_config("t", cfg))
+    prod.send_many(list(ENTRIES))
+    assert inner.sent == list(ENTRIES)
+
+
+def test_send_many_under_concurrency_interleaves_whole_records():
+    """Pipelined appends from many threads must never tear: every
+    record lands intact, each exactly once."""
+    broker = get_broker("ingest-conc")
+    broker.create_topic("In", partitions=1)
+    prod = _resilient("ingest-conc", "In")
+    n_threads, per = 8, 25
+
+    def worker(t):
+        prod.send_many([(f"k{t}-{i}", f"m{t}-{i}", {"t": str(t)})
+                        for i in range(per)])
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(n_threads)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    msgs = [m for _, m, _ in _messages(broker, "In")]
+    assert len(msgs) == n_threads * per
+    assert sorted(msgs) == sorted(f"m{t}-{i}" for t in range(n_threads)
+                                  for i in range(per))

@@ -1,0 +1,509 @@
+"""Crash-safe sharded speed layer (ISSUE 17 tentpole).
+
+Covers, deterministically and at unit scale, what the sim sweeps prove
+statistically (tests/test_sim_sweep.py, speed-shard-crash):
+
+- the SpeedCheckpoint single-document fence: stage → publish → commit,
+  atomic save, tolerant load, batch ids that survive restarts;
+- recover_pending: the destination log is the arbiter — found staged
+  sequences dedup, missing ones republish BYTE-EXACTLY from the staged
+  intent, never re-derived against a model the consume thread already
+  moved;
+- the chaos point itself (``speed-crash-mid-batch``): a kill between
+  the UP publishes and the checkpoint commit replays the batch but
+  folds nothing twice — the update topic after crash + recovery is
+  byte-identical to an uncrashed control run's;
+- the close()/micro-batch race regression: close interrupts the poll
+  wait promptly and joins the batch thread BEFORE tearing down the
+  model manager;
+- ring-sharded fold-in: two workers over the same input fold disjoint
+  item slices that cover every event exactly once.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from oryx_tpu.cluster.sharding import is_local_item
+from oryx_tpu.common.config import from_dict
+from oryx_tpu.kafka.api import KEY_UP
+from oryx_tpu.kafka.inproc import get_broker
+from oryx_tpu.lambda_rt.batch import BatchLayer
+from oryx_tpu.lambda_rt.speed import SpeedLayer
+from oryx_tpu.lambda_rt.speed_checkpoint import (
+    H_SPEED_BATCH, H_SPEED_SEQ, H_SPEED_SHARD, SpeedCheckpoint,
+    recover_pending, stamp_headers)
+from oryx_tpu.resilience import faults
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _base_config(tmp_path, broker_name, **extra):
+    overlay = {
+        "oryx.id": "it",
+        "oryx.input-topic.broker": f"memory://{broker_name}",
+        "oryx.input-topic.partitions": 1,
+        "oryx.input-topic.message.topic": "ItInput",
+        "oryx.update-topic.broker": f"memory://{broker_name}",
+        "oryx.update-topic.message.topic": "ItUpdate",
+        "oryx.batch.update-class": "oryx_tpu.app.als.update.ALSUpdate",
+        "oryx.speed.model-manager-class":
+            "oryx_tpu.app.als.speed.ALSSpeedModelManager",
+        "oryx.serving.model-manager-class":
+            "oryx_tpu.app.als.serving_manager.ALSServingModelManager",
+        "oryx.batch.storage.data-dir": str(tmp_path / "data"),
+        "oryx.batch.storage.model-dir": str(tmp_path / "model"),
+        "oryx.als.iterations": 3,
+        "oryx.als.implicit": True,
+        "oryx.als.hyperparams.features": 3,
+        "oryx.ml.eval.test-fraction": 0.0,
+        "oryx.resilience.retry.max-attempts": 2,
+        "oryx.resilience.retry.initial-backoff-ms": 1,
+        "oryx.resilience.retry.max-backoff-ms": 2,
+    }
+    overlay.update(extra)
+    return from_dict(overlay)
+
+
+def _produce_ratings(broker, topic, nu=20, ni=12, seed=5):
+    rng = np.random.default_rng(seed)
+    t = 1_700_000_000_000
+    n = 0
+    for u in range(nu):
+        for i in range(ni):
+            if rng.random() < 0.4:
+                broker.send(topic, None,
+                            f"u{u},i{i},{rng.exponential(1):.2f},{t}")
+                t += 1000
+                n += 1
+    return n
+
+
+def _replay_into(manager, broker, topic="ItUpdate"):
+    manager.consume(broker.consume(topic, from_beginning=True,
+                                   max_idle_sec=0.3))
+
+
+def _up_records(broker, topic="ItUpdate"):
+    end = broker.latest_offset(topic)
+    return [km for km in broker.read_range(topic, 0, end)
+            if km.key == KEY_UP]
+
+
+# -- the single-document fence -----------------------------------------------
+
+def test_checkpoint_roundtrips_one_atomic_document(tmp_path):
+    ck = SpeedCheckpoint(str(tmp_path / "ck"))
+    assert ck.input == {} and ck.pending is None and ck.next_batch == 0
+
+    batch = ck.stage_batch([5], ["ua", "ub"], {"ts": "123"})
+    assert batch == 0
+    # staging is durable BEFORE any publish: a reload sees the intent
+    ck2 = SpeedCheckpoint(str(tmp_path / "ck"))
+    assert ck2.pending == {"batch": 0, "ends": [5],
+                          "headers": {"ts": "123"},
+                          "updates": ["ua", "ub"]}
+    assert ck2.next_batch == 0  # the id is consumed only by the commit
+
+    ck2.commit_batch([5], dest_ends=[9])
+    ck3 = SpeedCheckpoint(str(tmp_path / "ck"))
+    assert ck3.pending is None
+    assert ck3.input == {0: 5}
+    assert ck3.dest_scanned == {0: 9}
+    assert ck3.next_batch == 1  # survives restart: ids never collide
+
+
+def test_checkpoint_unreadable_document_restarts_clean(tmp_path):
+    ck = SpeedCheckpoint(str(tmp_path / "ck"))
+    ck.commit_batch([3])
+    with open(ck.path, "wb") as f:
+        f.write(b"{not json")
+    ck2 = SpeedCheckpoint(str(tmp_path / "ck"))
+    # tolerant load: restart from group offsets, no pending batch —
+    # at-least-once, never a crash loop on a torn disk
+    assert ck2.input == {} and ck2.pending is None
+
+
+def test_commit_never_rewinds_dest_scan_mark(tmp_path):
+    ck = SpeedCheckpoint(str(tmp_path / "ck"))
+    ck.commit_batch([1], dest_ends=[10])
+    ck.commit_batch([2], dest_ends=[7])   # stale read of the head
+    assert ck.dest_scanned == {0: 10}
+    ck.commit_batch([3], dest_ends=[None])  # unknown head: keep mark
+    assert ck.dest_scanned == {0: 10}
+
+
+# -- recovery: the destination log is the arbiter ----------------------------
+
+class _Rec:
+    def __init__(self, headers):
+        self.headers = headers
+
+
+def test_recover_republishes_only_missing_seqs_byte_exactly(tmp_path):
+    ck = SpeedCheckpoint(str(tmp_path / "ck"))
+    batch = ck.stage_batch([7], ["ua", "ub", "uc"], {"ts": "1"})
+    # the crash landed mid-publish: seqs 0 and 2 made it durable.  A
+    # foreign shard's record and a foreign batch must not count.
+    dest = [_Rec(stamp_headers({}, "0/2", batch, 0)),
+            _Rec(stamp_headers({}, "1/2", batch, 1)),
+            _Rec(stamp_headers({}, "0/2", batch + 9, 1)),
+            _Rec(stamp_headers({}, "0/2", batch, 2)),
+            _Rec({}), _Rec(None)]
+    sent = []
+    republished, deduped = recover_pending(
+        ck, "0/2", lambda starts, ends: dest, [len(dest)],
+        lambda msg, h: sent.append((msg, h)))
+    assert (republished, deduped) == (1, 2)
+    # the missing seq re-sends the STAGED bytes under its original
+    # identity — byte-exact, not re-derived
+    assert sent == [("ub", stamp_headers({"ts": "1"}, "0/2", batch, 1))]
+    assert ck.pending is None
+    assert ck.input == {0: 7}
+    assert ck.next_batch == batch + 1
+    assert ck.dest_scanned == {0: len(dest)}
+
+
+def test_recover_is_a_noop_without_a_staged_batch(tmp_path):
+    ck = SpeedCheckpoint(str(tmp_path / "ck"))
+    ck.commit_batch([4])
+    called = []
+    assert recover_pending(ck, "0/1", lambda s, e: called.append(1),
+                           [0], lambda m, h: called.append(1)) == (0, 0)
+    assert not called
+    assert ck.input == {0: 4}
+
+
+def test_recovery_scan_is_incremental_from_dest_scanned(tmp_path):
+    ck = SpeedCheckpoint(str(tmp_path / "ck"))
+    ck.commit_batch([1], dest_ends=[40])
+    ck.stage_batch([2], ["u"], {})
+    seen = []
+
+    def read_dest(starts, ends):
+        seen.append((starts, ends))
+        return []
+
+    recover_pending(ck, "0/1", read_dest, [55], lambda m, h: None)
+    assert seen == [([40], [55])]
+
+
+# -- the chaos point: crash between publish and commit -----------------------
+
+def _copy_topic(src, dst, topic):
+    for km in src.read_range(topic, 0, src.latest_offset(topic)):
+        dst.send(topic, km.key, km.message, headers=km.headers)
+
+
+def test_crash_mid_batch_replays_dedup_not_double_fold(tmp_path):
+    """Kill the worker at ``speed-crash-mid-batch`` (UP publishes
+    durable, checkpoint commit lost); restart.  Recovery must dedup
+    every staged record against the destination log — zero new
+    publishes — and the final update topic and folded factors must be
+    byte-identical to an uncrashed control run over the same model
+    and input."""
+    cfg = _base_config(tmp_path, "spdcrash", **{
+        "oryx.speed.shard": "0/1",
+        "oryx.speed.checkpoint-dir": str(tmp_path / "speed-ckpt")})
+    broker = get_broker("spdcrash")
+    _produce_ratings(broker, "ItInput")
+    BatchLayer(cfg).run_one_generation()
+
+    # control universe: same trained model (records copied byte-wise,
+    # artifacts shared on disk), same input, no crash
+    ctl_cfg = _base_config(tmp_path, "spdctl", **{
+        "oryx.speed.shard": "0/1",
+        "oryx.speed.checkpoint-dir": str(tmp_path / "ctl-ckpt")})
+    ctl_broker = get_broker("spdctl")
+    ctl_broker.create_topic("ItInput", partitions=1)
+    ctl_broker.create_topic("ItUpdate", partitions=1)
+    _copy_topic(broker, ctl_broker, "ItInput")
+    _copy_topic(broker, ctl_broker, "ItUpdate")
+
+    new_lines = ["u0,i1,3.0,1800000000000",
+                 "newuser,i2,1.0,1800000000001",
+                 "u3,i5,2.0,1800000000002"]
+    for line in new_lines:
+        broker.send("ItInput", None, line)
+        ctl_broker.send("ItInput", None, line)
+
+    ctl = SpeedLayer(ctl_cfg)
+    _replay_into(ctl.model_manager, ctl_broker)
+    ctl.run_one_micro_batch()
+
+    speed1 = SpeedLayer(cfg)
+    _replay_into(speed1.model_manager, broker)
+    up_before = len(_up_records(broker))
+    faults.inject("speed-crash-mid-batch", mode="crash", times=1)
+    with pytest.raises(faults.InjectedCrash):
+        speed1.run_one_micro_batch()
+
+    # the dangerous intermediate state: every UP of the batch is
+    # durable, the input fence is NOT advanced, the intent is staged
+    staged = SpeedCheckpoint(str(tmp_path / "speed-ckpt" /
+                                 "shard-0-of-1"))
+    assert staged.pending is not None
+    n_staged = len(staged.pending["updates"])
+    assert n_staged > 0
+    up_mid = _up_records(broker)
+    assert len(up_mid) == up_before + n_staged
+
+    # restart: a fresh incarnation resolves the stage before anything
+    # else — all staged records found durable, NOTHING republished
+    speed2 = SpeedLayer(cfg)
+    speed2.run_one_micro_batch()
+    assert speed2.checkpoint.pending is None
+    assert speed2.dedup_skips == n_staged
+    assert speed2.metrics.counters_snapshot()[
+        "speed_shard_dedup_skips"] == n_staged
+    up_after = _up_records(broker)
+    assert len(up_after) == len(up_mid), \
+        "recovery republished records that were already durable"
+    # the fence committed: input offsets advanced past the batch
+    assert speed2.checkpoint.input == \
+        {0: broker.latest_offset("ItInput")}
+    assert broker.get_offsets(speed2._group, "ItInput") == \
+        [broker.latest_offset("ItInput")]
+
+    # byte-exactness vs the uncrashed control: same UP payloads in the
+    # same order, and byte-identical folded factors from full replay
+    ctl_ups = _up_records(ctl_broker)
+    assert [km.message for km in up_after] == \
+        [km.message for km in ctl_ups]
+
+    probe = SpeedLayer(_base_config(tmp_path, "spdcrash"))
+    _replay_into(probe.model_manager, broker)
+    ctl_probe = SpeedLayer(_base_config(tmp_path, "spdctl"))
+    _replay_into(ctl_probe.model_manager, ctl_broker)
+    got, ref = probe.model_manager.model, ctl_probe.model_manager.model
+    assert sorted(got.X.all_ids()) == sorted(ref.X.all_ids())
+    assert sorted(got.Y.all_ids()) == sorted(ref.Y.all_ids())
+    for uid in ref.X.all_ids():
+        assert np.array_equal(got.get_user_vector(uid),
+                              ref.get_user_vector(uid))
+    for iid in ref.Y.all_ids():
+        assert np.array_equal(got.get_item_vector(iid),
+                              ref.get_item_vector(iid))
+
+
+def test_crash_before_first_commit_resumes_from_pinned_fence(tmp_path):
+    """A worker killed before its FIRST micro-batch commit has no fence
+    yet; a restart that re-tails the (moved) head would silently skip
+    every record accepted in between.  ``_init_pos`` must pin the
+    initial tail position durably, so the restart resumes from the pin
+    and folds exactly the missed records."""
+    cfg = _base_config(tmp_path, "spdpin", **{
+        "oryx.speed.shard": "0/1",
+        "oryx.speed.checkpoint-dir": str(tmp_path / "pin-ckpt")})
+    broker = get_broker("spdpin")
+    _produce_ratings(broker, "ItInput")
+    BatchLayer(cfg).run_one_generation()
+    head0 = broker.latest_offset("ItInput")
+
+    first = SpeedLayer(cfg)
+    _replay_into(first.model_manager, broker)
+    assert first._init_pos(broker) == [head0]
+    # the tail position is durable BEFORE any micro-batch commits, and
+    # mirrored into the group so the input-lag gauge counts from it
+    assert first.checkpoint.input == {0: head0}
+    assert broker.get_offsets(first._group, "ItInput") == [head0]
+
+    # SIGKILL here: first never committed a batch.  Records keep
+    # landing while the shard is down.
+    up_before = len(_up_records(broker))
+    new_lines = ["u0,i1,3.0,1800000000000",
+                 "newuser,i2,1.0,1800000000001",
+                 "u3,i5,2.0,1800000000002"]
+    for line in new_lines:
+        broker.send("ItInput", None, line)
+
+    second = SpeedLayer(cfg)
+    _replay_into(second.model_manager, broker)
+    assert second._init_pos(broker) == [head0], \
+        "restart re-tailed the moved head, skipping durable records"
+    second.run_one_micro_batch()
+    # exactly the missed records folded — none skipped, none doubled
+    assert second.metrics.gauge_value("micro_batch_records") == \
+        len(new_lines)
+    assert second.checkpoint.input == {0: broker.latest_offset("ItInput")}
+    assert len(_up_records(broker)) > up_before
+
+
+def test_publish_failure_mid_batch_finishes_from_staged_bytes(tmp_path):
+    """An exhausted publish failure leaves the batch staged; the next
+    interval must finish it by republishing the STAGED bytes — never
+    re-deriving under the same batch id (the model has moved)."""
+    cfg = _base_config(tmp_path, "spdfail", **{
+        "oryx.speed.checkpoint-dir": str(tmp_path / "speed-ckpt")})
+    broker = get_broker("spdfail")
+    _produce_ratings(broker, "ItInput")
+    BatchLayer(cfg).run_one_generation()
+
+    speed = SpeedLayer(cfg)
+    _replay_into(speed.model_manager, broker)
+    broker.send("ItInput", None, "u1,i2,2.0,1800000000000")
+    up_before = len(_up_records(broker))
+
+    # the fault fires BEFORE the first send: intent staged, zero
+    # records durable — the all-missing recovery case
+    faults.inject("speed-publish", mode="error", times=1)
+    with pytest.raises(faults.InjectedFault):
+        speed.run_one_micro_batch()
+    assert speed.checkpoint.pending is not None
+    staged_updates = list(speed.checkpoint.pending["updates"])
+    assert len(_up_records(broker)) == up_before
+
+    # next micro-batch resolves the stage first: every staged record
+    # republished byte-exactly, stamped with the original batch id
+    speed.run_one_micro_batch()
+    assert speed.checkpoint.pending is None
+    tail = _up_records(broker)[up_before:]
+    assert [km.message for km in tail] == staged_updates
+    seqs = [(km.headers[H_SPEED_SHARD], int(km.headers[H_SPEED_BATCH]),
+             int(km.headers[H_SPEED_SEQ])) for km in tail]
+    assert seqs == [("0/1", 0, s) for s in range(len(staged_updates))]
+    assert speed.dedup_skips == 0  # nothing was durable: all republish
+
+
+# -- close()/micro-batch race (regression) -----------------------------------
+
+class BlockingSpeedManager:
+    """Stub manager whose build_updates blocks until released — makes
+    the close()-during-micro-batch window as wide as the test needs."""
+
+    last: "BlockingSpeedManager | None" = None
+
+    def __init__(self, config):
+        self.in_build = threading.Event()
+        self.release = threading.Event()
+        self.building = False
+        self.closed = False
+        self.closed_while_building = False
+        BlockingSpeedManager.last = self
+
+    def consume(self, updates):
+        for _ in updates:
+            pass
+
+    def build_updates(self, new_data):
+        self.building = True
+        self.in_build.set()
+        self.release.wait(15.0)
+        self.building = False
+        return ["stub-update"]
+
+    def close(self):
+        self.closed = True
+        self.closed_while_building = self.building
+
+
+def test_close_joins_inflight_micro_batch_before_teardown(tmp_path):
+    cfg = _base_config(tmp_path, "closerace", **{
+        "oryx.speed.model-manager-class":
+            "tests.test_speed_shard.BlockingSpeedManager",
+        "oryx.speed.streaming.generation-interval-sec": 1})
+    broker = get_broker("closerace")
+    broker.send("ItInput", None, "u0,i0,1.0,1800000000000")
+    broker.set_offsets("OryxGroup-SpeedLayer-it", "ItInput", [0])
+    speed = SpeedLayer(cfg)
+    speed.start()
+    try:
+        mgr = BlockingSpeedManager.last
+        assert mgr.in_build.wait(10.0), "micro-batch never started"
+        closer = threading.Thread(target=speed.close)
+        closer.start()
+        time.sleep(0.25)
+        # the regression: close() used to tear the manager down while
+        # the batch thread was still inside build_updates
+        assert not mgr.closed, \
+            "close() tore down the manager mid-micro-batch"
+        mgr.release.set()
+        closer.join(15.0)
+        assert not closer.is_alive()
+        assert mgr.closed
+        assert not mgr.closed_while_building
+    finally:
+        BlockingSpeedManager.last.release.set()
+
+
+def test_close_interrupts_long_poll_wait_promptly(tmp_path):
+    cfg = _base_config(tmp_path, "closewait", **{
+        "oryx.speed.model-manager-class":
+            "tests.test_speed_shard.BlockingSpeedManager",
+        "oryx.speed.streaming.generation-interval-sec": 300})
+    get_broker("closewait")
+    speed = SpeedLayer(cfg)
+    speed.start()
+    time.sleep(0.3)  # let the batch thread enter its 300 s poll wait
+    t0 = time.monotonic()
+    speed.close()
+    took = time.monotonic() - t0
+    assert took < 5.0, (
+        f"close() took {took:.1f}s against a 300 s poll interval — "
+        f"the wait is not going through the interruptible clock seam")
+    assert not speed._batch_thread.is_alive()
+    assert BlockingSpeedManager.last.closed
+
+
+# -- ring-sharded fold-in ----------------------------------------------------
+
+def test_two_shards_fold_disjoint_item_slices_covering_all(tmp_path):
+    cfg = _base_config(tmp_path, "shardsplit")
+    broker = get_broker("shardsplit")
+    _produce_ratings(broker, "ItInput")
+    BatchLayer(cfg).run_one_generation()
+
+    new_lines = [f"u{u},i{i},1.5,{1_800_000_000_000 + u * 13 + i}"
+                 for u in range(4) for i in range(6)]
+    for line in new_lines:
+        broker.send("ItInput", None, line)
+
+    workers = []
+    for s in range(2):
+        wcfg = _base_config(tmp_path, "shardsplit", **{
+            "oryx.speed.shard": f"{s}/2",
+            "oryx.speed.checkpoint-dir":
+                str(tmp_path / "shard-ckpt")})
+        w = SpeedLayer(wcfg)
+        assert w._group.endswith(f"-{s}x2")  # group per worker
+        _replay_into(w.model_manager, broker)
+        workers.append(w)
+
+    up_before = len(_up_records(broker))
+    for w in workers:
+        w.run_one_micro_batch()
+    ups = _up_records(broker)[up_before:]
+    assert ups, "no shard folded anything"
+
+    # every published delta is stamped by its worker, and every item
+    # delta belongs to the stamping worker's ring slice
+    by_shard: dict[str, set] = {"0/2": set(), "1/2": set()}
+    for km in ups:
+        tag = km.headers[H_SPEED_SHARD]
+        kind, id_ = json.loads(km.message)[:2]
+        if kind == "Y":
+            by_shard[tag].add(id_)
+            shard = int(tag.split("/")[0])
+            assert is_local_item(id_, shard, 2), \
+                f"shard {tag} published remote item {id_}"
+    assert by_shard["0/2"] and by_shard["1/2"]
+    assert not (by_shard["0/2"] & by_shard["1/2"])
+
+    # the split is exhaustive: both workers read the FULL input (the
+    # whole topic from 0 — history plus the new lines), and each event
+    # is remote to exactly one of the two, so the two skip counts sum
+    # to the total event count
+    total_events = broker.latest_offset("ItInput")
+    skipped = [w.model_manager.skipped_remote_events for w in workers]
+    assert sum(skipped) == total_events
+    assert all(0 < s < total_events for s in skipped)
