@@ -114,7 +114,24 @@ def measure_routes(model, batch: int | None = None,
         "path": "streaming" if streaming else "flat",
         "capacity": n_rows,
         "lsh_configured": lsh_configured,
+        # ANN half of the re-measure key: a route measured under one
+        # ANN shape (or certificate verdict) is stale under another
+        "ann_key": model._ann_route_key(),
     }
+    ann = model._ann
+    if ann is not None:
+        # the per-generation recall certificate, published verbatim on
+        # /metrics as model_metrics.kernel_route.ann — the operator-
+        # visible answer to "is ANN serving, and on what evidence"
+        route["ann"] = {
+            "recall": ann.recall,
+            "min_recall": ann.cfg.min_recall,
+            "recall_at": ann.cfg.recall_at,
+            "cells": int(ann.centroids.shape[0]),
+            "nprobe": ann.cfg.nprobe,
+            "routable": model._ann_routable(n_rows),
+            "index_bytes": ann.index_bytes,
+        }
     costs_exact: dict = {}
     costs_lsh: dict = {}
 
@@ -146,6 +163,11 @@ def measure_routes(model, batch: int | None = None,
                 # it only as the fallback when nothing else lowered
                 continue
             for lsh_on in variants:
+                if kind == "ivf" and lsh_on:
+                    # IVF is an exact-variant kind: the Hamming mask
+                    # and the cell probe are competing pruners, and
+                    # the dispatch never runs them composed
+                    continue
                 buckets, hp, mb = _lsh_parts(model, lsh_on)
                 costs = costs_lsh if lsh_on else costs_exact
                 point = (
@@ -246,7 +268,7 @@ def measure_routes(model, batch: int | None = None,
     route["phase_a_costs_ms"] = effective
     route["chosen"] = best(effective)[0]
     if streaming and route["chosen"] in ("i8_fold", "i8", "fold",
-                                         "pallas"):
+                                         "pallas", "ivf"):
         # rebuild the WINNER's mirror pre-traffic: the per-kind
         # eviction above dropped it with the losers, and the first
         # live drain must not pay the O(N) mirror build + upload

@@ -25,6 +25,7 @@ from ...common.lang import RateLimitCheck
 from ...kafka.api import KEY_MODEL, KEY_MODEL_REF, KEY_UP
 from ..pmml_utils import read_pmml_from_update_key_message
 from . import common as als_common
+from . import ivf
 from . import slices
 from .rescorer import load_rescorer_providers
 from .serving_model import ALSServingModel
@@ -54,6 +55,9 @@ class ALSServingModelManager(AbstractServingModelManager):
         self.fold_scan = config.get_string("oryx.serving.api.fold-scan")
         if self.fold_scan not in ("auto", "true", "false"):
             raise ValueError("fold-scan must be auto/true/false")
+        # IVF ANN serving path (oryx.als.ann.*, ISSUE 18): parsed and
+        # validated at boot like every other serving knob
+        self.ann_config = ivf.AnnConfig.from_config(config)
         if self.item_shards < 1 or (self.item_shards
                                     & (self.item_shards - 1)):
             raise ValueError("item-shards must be a power of two >= 1")
@@ -114,6 +118,18 @@ class ALSServingModelManager(AbstractServingModelManager):
         # sum of the owned slices' manifest Gramians: /shard/yty
         # answers from it without a device scan until a Y write lands
         self._slice_yty: "object | None" = None
+        # -- IVF ANN index (ivf.py) --------------------------------------
+        # device bytes pinned by the current generation's IVF mirror
+        # and how many generations failed CLOSED to the exact kernel
+        # (corrupt artifact / failed build / failed recall measurement)
+        # — surfaced as gauges on /metrics by the serving layer
+        self.ann_index_bytes = 0
+        self.ann_index_fallbacks = 0
+        # per-generation published-index state collected during the
+        # slice load, consumed by _maybe_build_ann
+        self._ann_centroid_entry: dict | None = None
+        self._ann_cells_by_id: dict[str, int] = {}
+        self._ann_artifacts_broken = False
 
     def get_model(self) -> ALSServingModel | None:
         return self.model
@@ -176,6 +192,10 @@ class ALSServingModelManager(AbstractServingModelManager):
                         time.monotonic() - self._model_received_at, 6)
                     self._model_received_at = None
                 model.precompute_solvers()
+                # replay-loaded factors: build the IVF index + measure
+                # the recall certificate before routing, so the route
+                # below is measured against the chain ANN may join
+                self._maybe_build_ann(None)
                 # with the factors loaded, time each eligible kernel
                 # path for the live shape so serving routes by
                 # measured cost (re-measures only if the store's
@@ -223,7 +243,9 @@ class ALSServingModelManager(AbstractServingModelManager):
                     self.rescorer_provider, dtype=self.factor_dtype,
                     item_shards=self.item_shards,
                     int8_selection=self.int8_selection,
-                    fold_scan=self.fold_scan)
+                    fold_scan=self.fold_scan,
+                    ann_config=self.ann_config
+                    if self.ann_config.enabled else None)
             _log.info("Updating model")
             x_ids = set(pmml_io.get_extension_content(pmml, "XIDs") or [])
             y_ids = set(pmml_io.get_extension_content(pmml, "YIDs") or [])
@@ -246,11 +268,21 @@ class ALSServingModelManager(AbstractServingModelManager):
             # immediately (the retains above already pruned rows); a
             # successful slice load below sets the fresh one
             self._slice_yty = None
+            # reset the previous generation's published-index state
+            # before any load path repopulates it
+            self._ann_centroid_entry = None
+            self._ann_cells_by_id = {}
+            self._ann_artifacts_broken = False
             if manifest is not None:
                 # sharded distribution: bulk-load exactly this shard's
                 # slices (O(catalog/N)); a bad slice fails closed to
                 # the monolithic artifacts — ready either way
                 self._load_from_manifest(model_dir, manifest)
+            # IVF index build INSIDE the load clock: `model_load_s`
+            # covers it (the index is part of being servable at the
+            # advertised latency), and it must precede refresh_route so
+            # the measured route includes the "ivf" kind
+            self._maybe_build_ann(model_dir)
             if (self._model_received_at is not None
                     and self.model.get_fraction_loaded()
                     >= self.min_model_load_fraction):
@@ -306,6 +338,7 @@ class ALSServingModelManager(AbstractServingModelManager):
             full = slices.read_manifest(model_dir)
             grams = (full or {}).get("gramians")
             entries = {int(e["slice"]): e for e in manifest["slices"]}
+            self._ann_centroid_entry = manifest.get("ann")
             for s in owned:
                 entry = entries[s]
                 ids, matrix, ordinals = slices.read_slice(
@@ -316,6 +349,7 @@ class ALSServingModelManager(AbstractServingModelManager):
                 total_bytes += int(entry.get("bytes", 0))
                 if grams is not None:
                     gramian += np.asarray(grams[s], dtype=np.float64)
+                self._collect_slice_ann(model_dir, entry, ids)
             x_ids, X, known = slices.read_x_known(
                 model_dir, manifest["x"], features)
             if x_ids:
@@ -338,6 +372,11 @@ class ALSServingModelManager(AbstractServingModelManager):
                 TypeError, ValueError) as e:
             self.slice_load_fallbacks += 1
             self._slice_yty = None
+            # a failed slice load discredits the whole manifest, the
+            # published index artifacts with it: the ANN build (if
+            # enabled) trains locally over whatever the fallback loads
+            self._ann_centroid_entry = None
+            self._ann_cells_by_id = {}
             _log.warning("Slice load failed (%s); falling back to the "
                          "monolithic artifacts", e)
             self._load_full_artifacts(model_dir)
@@ -372,6 +411,102 @@ class ALSServingModelManager(AbstractServingModelManager):
             _log.error("Monolithic artifact fallback also failed (%s); "
                        "replica will not reach ready until the store "
                        "returns", e)
+
+    # -- IVF ANN index (ivf.py, ISSUE 18) ------------------------------------
+
+    def _collect_slice_ann(self, model_dir: str, entry: dict,
+                           ids: list[str]) -> None:
+        """Read one owned slice's published cell assignments.  A
+        corrupt/missing index artifact (chaos point
+        ``ann-index-corrupt``) never fails the SLICE load — the
+        factors are intact — but marks the generation's published
+        index broken so ``_maybe_build_ann`` fails CLOSED to the exact
+        kernel."""
+        aent = entry.get("ann")
+        if aent is None or not self.ann_config.enabled \
+                or self._ann_artifacts_broken:
+            return
+        try:
+            cells = ivf.read_slice_cells(model_dir, aent)
+            self._ann_cells_by_id.update(zip(ids, cells))
+        except ivf.AnnIndexError as e:
+            self._ann_artifacts_broken = True
+            _log.warning("ANN index artifact unusable (%s); this "
+                         "generation will serve on the exact kernel", e)
+
+    def _maybe_build_ann(self, model_dir: str | None) -> None:
+        """Build the generation's IVF index over this replica's owned
+        rows and measure its recall certificate against the exact
+        kernel (``ivf.measure_recall``) — BEFORE routing, so
+        ``refresh_route`` measures the chain ANN may join.  Published
+        artifacts (centroids + per-slice cells) skip the local k-means
+        training; any failure anywhere fails CLOSED to the exact
+        kernel with ``ann_index_fallbacks`` — ANN is an optimization,
+        never a readiness gate."""
+        cfg = self.ann_config
+        model = self.model
+        if not cfg.enabled or model is None or model._item_shards > 1 \
+                or len(model.Y) == 0:
+            return
+        try:
+            if self._ann_artifacts_broken:
+                raise ivf.AnnIndexError(
+                    "published index artifacts unreadable")
+            cells = None
+            if self._ann_centroid_entry is not None \
+                    and model_dir is not None:
+                centroids = ivf.read_centroids(
+                    model_dir, self._ann_centroid_entry)
+                cells = self._published_cells()
+            else:
+                yv, ya, _ids = model.Y.host_arrays()
+                centroids = ivf.train_generation_centroids(
+                    yv[ya][:, :model.features], cfg)
+            state = ivf.AnnState(cfg, centroids, cells=cells)
+            model.attach_ann(state)
+            vecs, active, version = model.Y.device_arrays_versioned()
+            mirror = model._cached_ivf(vecs, active, version)
+            state.recall = ivf.measure_recall(model, mirror, cfg)
+            self.ann_index_bytes = mirror.index_bytes
+            if state.recall < cfg.min_recall:
+                _log.warning(
+                    "IVF recall certificate FAILED for generation %d: "
+                    "recall@%d %.4f < min-recall %.2f — serving stays "
+                    "on the exact kernel", self.generation,
+                    cfg.recall_at, state.recall, cfg.min_recall)
+            else:
+                _log.info(
+                    "IVF index ready for generation %d: %d cells, "
+                    "nprobe %d, recall@%d %.4f, %d bytes",
+                    self.generation, int(state.centroids.shape[0]),
+                    cfg.nprobe, cfg.recall_at, state.recall,
+                    mirror.index_bytes)
+        except Exception as e:  # noqa: BLE001 — fail closed to exact
+            self.ann_index_fallbacks += 1
+            self.ann_index_bytes = 0
+            model.attach_ann(None)
+            _log.warning("IVF ANN index build failed (%s); generation "
+                         "%d serves on the exact kernel", e,
+                         self.generation)
+
+    def _published_cells(self) -> "np.ndarray | None":
+        """Published per-slice cell assignments re-aligned to the
+        store's row slots.  Partial coverage (a row the artifacts do
+        not name) returns None — the mirror build assigns on device
+        instead, which is always correct."""
+        by_id = self._ann_cells_by_id
+        if not by_id:
+            return None
+        row_ids = self.model.Y.row_ids()
+        cells = np.zeros(len(row_ids), dtype=np.int32)
+        for i, rid in enumerate(row_ids):
+            if rid is None:
+                continue
+            c = by_id.get(rid)
+            if c is None:
+                return None
+            cells[i] = c
+        return cells
 
     def partial_yty(self) -> "np.ndarray | None":
         """This shard's Gramian from the manifest's per-slice partials

@@ -897,7 +897,7 @@ class ALSServingModel(FactorModelBase, ServingModel):
                  sample_rate: float = 1.0, rescorer_provider=None,
                  dtype="float32", item_shards: int = 1, mesh=None,
                  int8_selection: str | bool = "auto",
-                 fold_scan: str | bool = "auto"):
+                 fold_scan: str | bool = "auto", ann_config=None):
         """``item_shards`` > 1 row-shards the item matrix over that many
         devices (``oryx.serving.api.item-shards``) and routes the
         dot-product top-N scan through one SPMD program with an
@@ -985,6 +985,17 @@ class ALSServingModel(FactorModelBase, ServingModel):
         self._fold_version: int = -1
         self._penalty_i: jax.Array | None = None
         self._penalty_i_version: int = -1
+        # IVF ANN serving path (oryx.als.ann.*, ISSUE 18): the small
+        # per-generation state (centroids + recall certificate) is
+        # attached by the manager at model load; the big device mirror
+        # is version-keyed like every other phase-A mirror.  "ivf"
+        # joins the routed kind chain only while the certificate holds
+        # (_ann_routable) — below min-recall the chain is exactly what
+        # it was before ANN existed
+        self._ann_cfg = ann_config
+        self._ann = None
+        self._ivf_mirror = None
+        self._ivf_mirror_version: int = -1
         self._bucket_lock = threading.Lock()
         # observability: exact-scan recomputes forced by a failed
         # two-phase certificate (expected ~0; see _APPROX_RECALL)
@@ -1078,6 +1089,70 @@ class ALSServingModel(FactorModelBase, ServingModel):
         return (self._item_shards == 1 and self.lsh is not None
                 and self.lsh.num_hashes > 0
                 and self.lsh.max_bits_differing < self.lsh.num_hashes)
+
+    # -- IVF ANN path (app/als/ivf.py, ISSUE 18) -----------------------------
+
+    def attach_ann(self, state) -> None:
+        """Install the generation's ANN state (ivf.AnnState: centroids
+        + recall certificate).  None detaches — the "ivf" kind leaves
+        the chain and any mirror is dropped.  The manager calls this
+        at model load, BEFORE refresh_route: the route's re-measure
+        key includes the ANN shape (_ann_route_key), so an attach is
+        what invalidates a cached route."""
+        with self._bucket_lock:
+            self._ann = state
+            self._ivf_mirror = None
+            self._ivf_mirror_version = -1
+
+    def _ann_routable(self, n_rows: int) -> bool:
+        """True when the "ivf" kind may serve: state attached, the
+        per-generation recall certificate measured AND at or above
+        ``oryx.als.ann.min-recall``, single-chip, block-aligned
+        capacity.  ONE derivation gating the dispatch chain, the
+        router, and the warmup — the router can provably never serve
+        ANN below min-recall because below it "ivf" is not a kind at
+        all."""
+        a = self._ann
+        return (a is not None and self._item_shards == 1
+                and a.recall is not None
+                and a.recall >= a.cfg.min_recall
+                and n_rows % _BLOCK_ROWS == 0
+                and n_rows // _BLOCK_ROWS
+                >= int(a.centroids.shape[0]))
+
+    def _ann_route_key(self) -> tuple | None:
+        """ANN half of the kernel-route cache key: config shape plus
+        whether the certificate currently admits routing.  A new
+        generation's certificate flipping either way must force a
+        re-measure (the kind chain changed)."""
+        a = self._ann
+        if a is None:
+            return None
+        return a.cfg.route_key() + (
+            self._ann_routable(len(self.Y.row_ids())),)
+
+    def _cached_ivf(self, vecs, active, version):
+        """Cell-contiguous int8 IVF mirror (ivf.IVFMirror), rebuilt
+        device-to-device when the Y snapshot version changes — same
+        lifecycle as the other phase-A mirrors.  The first build after
+        a generation load consumes the trainer-published assignment if
+        one shipped; later version bumps reassign on device (same
+        centroids, same lowest-index tie-break: same cells)."""
+        from . import ivf as _ivf
+        with self._bucket_lock:
+            a = self._ann
+            if a is None:
+                raise ValueError("no ANN state attached")
+            if self._ivf_mirror is None \
+                    or self._ivf_mirror_version != version:
+                cells = a.cells if a.cells is not None \
+                    and len(a.cells) == int(vecs.shape[0]) else None
+                a.cells = None  # one-shot: stale after any store write
+                self._ivf_mirror = _ivf.build_mirror(
+                    vecs, active, a, _BLOCK_ROWS, cells=cells)
+                self._ivf_mirror_version = version
+                a.index_bytes = self._ivf_mirror.index_bytes
+            return self._ivf_mirror
 
     def warm_serving_kernels(self, how_many: int = 10,
                              max_batch: int = 1024) -> None:
@@ -1203,6 +1278,7 @@ class ALSServingModel(FactorModelBase, ServingModel):
             "i8": {"_i8", "_penalty_i"},
             "fold": {"_fold", "_fold_bkt"},
             "pallas": {"_penalty"},
+            "ivf": {"_ivf_mirror"},
         }.get(keep_kind, set())
         with self._bucket_lock:
             for attr, ver in (("_i8", "_i8_version"),
@@ -1210,7 +1286,8 @@ class ALSServingModel(FactorModelBase, ServingModel):
                               ("_fold", "_fold_version"),
                               ("_fold_bkt", "_fold_bkt_version"),
                               ("_penalty", "_penalty_version"),
-                              ("_penalty_i", "_penalty_i_version")):
+                              ("_penalty_i", "_penalty_i_version"),
+                              ("_ivf_mirror", "_ivf_mirror_version")):
                 if attr not in keep:
                     setattr(self, attr, None)
                     setattr(self, ver, -1)
@@ -1461,8 +1538,10 @@ class ALSServingModel(FactorModelBase, ServingModel):
         # reordered by MEASURED ascending cost once measure_routes has
         # timed the live shape (config stops deciding, the stopwatch
         # does); invariant across a drain's windows
-        kinds = self._route_order(list(static_kinds), n_rows,
-                                  lsh_on=buckets is not None)
+        kinds = self._route_order(
+            [kk for kk in static_kinds
+             if kk != "ivf" or buckets is None],
+            n_rows, lsh_on=buckets is not None)
         for qw in windows:
             dispatched = False
             for kind in kinds:
@@ -1540,6 +1619,14 @@ class ALSServingModel(FactorModelBase, ServingModel):
             return _batch_top_n_twophase_pallas(
                 vecs, qw, ctx["penalty"], active, buckets, hp, k, bs,
                 ksel, mb)
+        if kind == "ivf":
+            from . import ivf as _ivf
+            if "ivf" not in ctx:
+                ctx["ivf"] = self._cached_ivf(vecs, active, version)
+            return _ivf.batch_top_n_ivf(
+                ctx["ivf"], vecs, qw, k, bs,
+                _i8_ksel(ksel, int(vecs.shape[0]), bs),
+                self._ann.cfg.nprobe)
         if kind == "scan":
             return _batch_top_n_twophase_kernel(
                 vecs, qw, active, buckets, hp, k, chunk, bs, ksel, mb)
@@ -1566,6 +1653,13 @@ class ALSServingModel(FactorModelBase, ServingModel):
         fold = _fold_eligible(width, self.features, bs) \
             if self._fold_enabled() else 1
         kinds: list[str] = []
+        # IVF heads the static chain where its certificate admits it:
+        # it streams ~nprobe/cells of everyone else's bytes.  It is an
+        # exact-variant kind only (the Hamming mask and the cell probe
+        # are competing pruners — _dispatch_twophase and the router
+        # drop it on masked drains)
+        if self._ann_routable(n_rows):
+            kinds.append("ivf")
         if eligible:
             if want_i8 and fold > 1:
                 kinds.append("i8_fold")
@@ -1628,7 +1722,8 @@ class ALSServingModel(FactorModelBase, ServingModel):
             r = self._route
             if (not force and r is not None
                     and self._route_capacity == n_rows
-                    and r.get("lsh_configured") == self._lsh_active()):
+                    and r.get("lsh_configured") == self._lsh_active()
+                    and r.get("ann_key") == self._ann_route_key()):
                 return r
             try:
                 route = measure_routes(self, batch=batch, m=m)
@@ -1658,7 +1753,8 @@ class ALSServingModel(FactorModelBase, ServingModel):
         re-configured sample rate, invalidates it)."""
         r = self._route
         return r if (r is not None and self._route_capacity == n_rows
-                     and r.get("lsh_configured") == self._lsh_active()) \
+                     and r.get("lsh_configured") == self._lsh_active()
+                     and r.get("ann_key") == self._ann_route_key()) \
             else None
 
     def _sharded_top_n_batch(self, hm: list[int], Q: np.ndarray,

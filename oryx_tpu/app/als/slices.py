@@ -131,16 +131,33 @@ def _write_artifact(model_dir: str, rel_path: str, payload: bytes) -> int:
 def publish_sliced(model_dir: str, y_ids: list[str], Y,
                    x_ids: list[str], X,
                    known: dict[str, list[str]] | None,
-                   ring: int) -> dict:
+                   ring: int, ann=None) -> dict:
     """Write the sliced artifacts + manifest under ``model_dir`` and
     return the slim manifest (no Gramians) for the MODEL-REF envelope.
 
     Rows are serialized with the same 8-decimal rounding as
     ``save_features``, so a slice-loaded replica holds bit-identical
     float32 vectors to one that replayed the UP stream rendered from
-    the monolithic artifacts."""
+    the monolithic artifacts.
+
+    ``ann`` is an optional ``(centroids, cells)`` pair — the trainer's
+    IVF coarse quantizer and the per-item cell assignment aligned to
+    ``y_ids`` (``oryx.als.ann.publish-index``).  Centroids publish
+    once per generation; each slice's assignments ride next to its
+    factor artifact, so a serving replica's index build stays
+    O(catalog/N) — it reads cells only for the slices it owns."""
     if ring < 1:
         raise ValueError(f"slice ring must be >= 1, got {ring}")
+    ann_cells = None
+    if ann is not None:
+        from . import ivf
+        centroids, ann_cells = ann
+        ann_cells = np.asarray(ann_cells, dtype=np.int64)
+        if len(ann_cells) != len(y_ids):
+            raise ValueError(
+                f"{len(ann_cells)} cell assignments for "
+                f"{len(y_ids)} items")
+        ann_entry = ivf.publish_centroids(model_dir, centroids)
     features = int(Y.shape[1]) if len(y_ids) else \
         (int(X.shape[1]) if len(x_ids) else 0)
     slices_meta = []
@@ -155,8 +172,19 @@ def publish_sliced(model_dir: str, y_ids: list[str], Y,
         payload = _gzip_lines(lines)
         rel = f"{_SLICES_DIR}/slice-{s:05d}.jsonl.gz"
         crc = _write_artifact(model_dir, rel, payload)
-        slices_meta.append({"slice": s, "path": rel, "rows": len(ids),
-                            "bytes": len(payload), "crc32": crc})
+        entry = {"slice": s, "path": rel, "rows": len(ids),
+                 "bytes": len(payload), "crc32": crc}
+        if ann_cells is not None:
+            cells_payload = _gzip_lines([json.dumps(
+                [int(ann_cells[i]) for i in idxs],
+                separators=(",", ":"))])
+            cells_rel = f"{_SLICES_DIR}/ann-{s:05d}.json.gz"
+            cells_crc = _write_artifact(model_dir, cells_rel,
+                                        cells_payload)
+            entry["ann"] = {"path": cells_rel, "rows": len(ids),
+                            "bytes": len(cells_payload),
+                            "crc32": cells_crc}
+        slices_meta.append(entry)
         # the partial Gramian of EXACTLY the float32 rows a consumer
         # will hold, accumulated in f64: partials over disjoint row
         # sets sum to the full YtY within the docs/NUMERICS.md bound
@@ -188,6 +216,8 @@ def publish_sliced(model_dir: str, y_ids: list[str], Y,
               "known_items": known is not None},
         "gramians": gramians,
     }
+    if ann is not None:
+        manifest["ann"] = ann_entry
     with store.open_write(store.join(model_dir, MANIFEST_FILE)) as f:
         f.write(json.dumps(manifest).encode("utf-8"))
     return {k: v for k, v in manifest.items() if k != "gramians"}

@@ -88,6 +88,14 @@ class ALSUpdate(MLUpdate):
         # the per-slice artifacts a too-large-to-inline model publishes
         # alongside its MODEL-REF; 0 disables (pure reference behavior)
         self.publish_slices = config.get_int("oryx.als.publish.slices")
+        # IVF ANN index publish (ivf.py): train the coarse quantizer at
+        # publish time and ship centroids + per-slice cell assignments
+        # with the sliced artifacts, so a serving replica's index build
+        # skips the k-means training entirely (oryx.als.ann.*)
+        self.publish_ann_index = config.get_bool(
+            "oryx.als.ann.publish-index")
+        from .ivf import AnnConfig
+        self.ann_config = AnnConfig.from_config(config)
         if self.iterations <= 0:
             raise ValueError("iterations must be positive")
         if not 0.0 < self.decay_factor <= 1.0:
@@ -270,8 +278,17 @@ class ALSUpdate(MLUpdate):
                 all_events = als_common.parse_events(
                     list(new_data) + list(past_data), 1.0, 0.0)
                 known = als_common.build_known_items(all_events)
+            ann = None
+            if self.publish_ann_index and len(y_ids):
+                from ...ops import ann as ops_ann
+                from . import ivf
+                centroids = ivf.train_generation_centroids(
+                    Y, self.ann_config)
+                cells = ops_ann.assign_cells(Y, centroids)
+                ann = (centroids, cells)
             slim = slices.publish_sliced(model_dir, y_ids, Y, x_ids, X,
-                                         known, self.publish_slices)
+                                         known, self.publish_slices,
+                                         ann=ann)
             _log.info("Published sharded manifest: %d slices, %d items, "
                       "%d users at %s", self.publish_slices, len(y_ids),
                       len(x_ids), model_dir)

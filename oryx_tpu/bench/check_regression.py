@@ -26,7 +26,10 @@ Three artifact families share the machinery, selected by ``--kind``:
   back-compat.  Since r15 the write-heavy rung (ISSUE 17,
   ``--write-heavy``) gates as the ``(..., "writes")`` pseudo-cell on
   sustained ACKED writes/s through the durable-ack ingest path, same
-  back-compat.
+  back-compat.  Also since r15 the IVF-ANN rung (ISSUE 18, ``--ann``)
+  gates as the ``(..., "ann")`` pseudo-cell on the ANN door's
+  sustained qps at the large-catalog cell (recall certificate and
+  speedup-vs-exact ride along), same back-compat.
 - ``obs``: ``BENCH_OBS_OVERHEAD_*.json`` — the observability
   hot-path microbench (bench/obs_overhead.py).  Gates on two rules:
   a HARD absolute budget (the unsampled per-request pipeline must
@@ -237,6 +240,26 @@ def _cells(doc: dict) -> dict:
                         w.get("ingest_to_servable_ms"),
                     "p50_shed_ms":
                         (w.get("overload") or {}).get("p50_shed_ms"),
+                }
+            # ISSUE 18 added the IVF-ANN rung (`--ann`): it gates as
+            # its own (..., "ann") pseudo-cell on the ANN door's
+            # sustained qps at the probe's large-catalog cell, so an
+            # index-build or routing regression (ANN silently failing
+            # closed to the exact kernel serves correctly but at
+            # exact-kernel speed — the gated number collapses) cannot
+            # hide behind the healthy small-catalog cells.  The recall
+            # certificate, the speedup over the exact door on the SAME
+            # generation, and p99 ride along for diagnosis.  Pre-r15
+            # artifacts simply lack the cell.
+            a = r.get("ann")
+            if isinstance(a, dict) \
+                    and a.get("open_loop_sustained_qps") is not None:
+                out[key + ("ann",)] = {
+                    "open_loop_sustained_qps":
+                        a["open_loop_sustained_qps"],
+                    "speedup_vs_exact": a.get("speedup_vs_exact"),
+                    "recall": (a.get("certificate") or {}).get("recall"),
+                    "sustained_p99_ms": a.get("sustained_p99_ms"),
                 }
         return out
     return {(r["features"], r["items"], r["lsh"]): r

@@ -441,6 +441,7 @@ def run_recommend_open_loop(base_url: str, user_ids: list[str],
         "cache": cache,
         "p50_ms": round(float(np.percentile(lat, 50)), 1) if len(lat) else None,
         "p95_ms": round(float(np.percentile(lat, 95)), 1) if len(lat) else None,
+        "p99_ms": round(float(np.percentile(lat, 99)), 1) if len(lat) else None,
         # mean time requests spent waiting for a free client slot past
         # their scheduled arrival — the open-loop backlog signal
         "mean_sched_lateness_ms": round(float(np.mean(late)), 1)

@@ -67,11 +67,17 @@ def _compile(report: dict, name: str, fn, *args, **static) -> None:
 def warm_serving_shapes(features: int, items: int, dtype: str,
                         sample_rate: float, report: dict,
                         how_many: int = 10,
-                        max_flat_batch: int = 1024) -> None:
+                        max_flat_batch: int = 1024,
+                        ann=None) -> None:
     """AOT-compile every serving kernel variant for one (items,
-    features) ladder rung, from avals only."""
+    features) ladder rung, from avals only.  ``ann`` (an
+    ``ivf.AnnConfig``) additionally warms the IVF phase-A ladder —
+    index shapes derive from ``ivf.mirror_shapes`` over the SAME
+    ``planned_capacity`` that ``bulk_load`` obeys, so warmed shapes
+    stay lock-stepped with what a model load will build."""
     import jax.numpy as jnp
 
+    from ..app.als import ivf as ivf_mod
     from ..app.als import serving_model as sm
     from ..app.als.feature_vectors import planned_capacity, resolve_dtype
     from ..app.als.lsh import LocalitySensitiveHash, _bucket_kernel
@@ -168,6 +174,40 @@ def warm_serving_shapes(features: int, items: int, dtype: str,
                      sm._batch_top_n_twophase_kernel, Y, Q, A, buckets,
                      hp, k=k, chunk=chunk, bs=bs, ksel=ksel,
                      max_bits=mb)
+            if (buckets is None and ann is not None and ann.enabled
+                    and cap // bs >= ann.cells):
+                # IVF phase-A ladder (exact variant only — the kind is
+                # never dispatched on masked drains).  The permuted
+                # layout is static in (cap, cells, bs); only the probe
+                # table's pow2 width (bpc) is data-dependent, so warm
+                # the expected width and the next one up — cell-count
+                # skew past 2x the mean block load recompiles once at
+                # load, no worse than a cold shape
+                shp = ivf_mod.mirror_shapes(cap, ann.cells, bs)
+                nb, rows = shp["blocks"], shp["rows"]
+                C = ann.cells
+                nprobe = min(ann.nprobe, C)
+                e = max(1, -(-cap // (C * bs)))
+                e = 1 << (e - 1).bit_length()
+                for bpc in (e, e * 2):
+                    pp = nprobe * bpc
+                    ks = min(max(sm._i8_ksel(ksel, cap, bs),
+                                 -(-k // bs)), pp)
+                    if ks * bs < k:
+                        continue
+                    _compile(
+                        report, f"{tag}: ivf bpc={bpc}{suffix}",
+                        ivf_mod._ivf_top_n_kernel, Y,  # noqa: SLF001
+                        Q, _aval((rows, W), jnp.int8),
+                        _aval((nb,), jnp.float32),
+                        _aval((nb,), jnp.float32),
+                        _aval((nb, bs), jnp.int32),
+                        _aval((rows,), jnp.bool_),
+                        _aval((rows,), jnp.int32),
+                        _aval((C, W), jnp.float32),
+                        _aval((C, bpc), jnp.int32),
+                        k=k, bs=bs, ksel=ks, nprobe=nprobe,
+                        pchunk=min(ivf_mod._PROBE_CHUNK, pp))
             if not pallas_ok:
                 continue
             P = _aval((cap // bs, bs), jnp.float32)
@@ -248,8 +288,12 @@ def run_warmup(config, items_list: list[int], features_list: list[int],
             "oryx.compile-cache-dir is null: warmup compilations will "
             "NOT persist — this run warms only the current process")
     sample_rate = config.get_double("oryx.als.sample-rate")
+    from ..app.als.ivf import AnnConfig
+    ann = AnnConfig.from_config(config)
     report: dict = {"metric": "aot_warmup", "cache_dir": cache_dir,
                     "compiled": [], "failed": []}
+    if ann.enabled:
+        report["ann"] = {"cells": ann.cells, "nprobe": ann.nprobe}
     item_shards = config.get_int("oryx.serving.api.item-shards")
     if item_shards > 1:
         # the sharded SPMD scan compiles against a live device mesh —
@@ -271,7 +315,8 @@ def run_warmup(config, items_list: list[int], features_list: list[int],
         for items in items_list:
             for features in features_list:
                 warm_serving_shapes(features, items, dtype, sample_rate,
-                                    report, how_many=how_many)
+                                    report, how_many=how_many,
+                                    ann=ann if ann.enabled else None)
     if train_ratings and train_rank:
         _warm_training(train_ratings, train_rank, sample_rate,
                        config.get_string("oryx.als.factor-dtype"),

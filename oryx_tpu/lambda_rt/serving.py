@@ -163,6 +163,16 @@ class ServingLayer:
             self.metrics.gauge_fn(
                 "slice_load_fallbacks",
                 lambda: float(mgr.slice_load_fallbacks))
+            # IVF ANN serving index (app/als/ivf.py): device bytes the
+            # generation's index pins, and generations that failed
+            # CLOSED to the exact kernel (corrupt artifact or failed
+            # build/certificate)
+            self.metrics.gauge_fn(
+                "ann_index_bytes",
+                lambda: float(getattr(mgr, "ann_index_bytes", 0)))
+            self.metrics.gauge_fn(
+                "ann_index_fallbacks",
+                lambda: float(getattr(mgr, "ann_index_fallbacks", 0)))
         # SLO burn-rate engine (obs/slo.py; None = disabled): evaluated
         # lazily whenever the gauges are read, alert state at /admin/slo
         self.slo_engine = engine_from_config(config, self.metrics)

@@ -1,1 +1,1 @@
-from . import als_fold_in, solver, vectors  # noqa: F401
+from . import als_fold_in, ann, solver, vectors  # noqa: F401

@@ -1,0 +1,98 @@
+"""ANN coarse-quantizer kernels: mini-batch k-means over item factors.
+
+The IVF serving index (``app/als/ivf.py``) partitions the item matrix
+by nearest centroid and scores only the ``nprobe`` nearest cells per
+query.  This module holds the device-side primitives that train and
+apply that partition:
+
+- ``lloyd_step`` — one Lloyd's iteration as two MXU ops (assignment =
+  distance matmul-argmin, update = one-hot matmul accumulate), the
+  batch form of the reference's per-point ``closestCluster`` scan
+  (KMeansUtils.java:29) that ``app/kmeans/common.assign_points``
+  already uses at request time;
+- ``train_centroids`` — k-means over a deterministic sample of the
+  rows (seeded; index builds must be reproducible per generation for
+  the PR 8/PR 11 result-cache byte-identity contract);
+- ``assign_cells`` — full-catalog nearest-centroid assignment, one
+  matmul-argmin over the whole factor matrix.
+
+Centroids train in float32 regardless of the store dtype: the cell
+partition is a *routing* structure, not a scoring one — scores are
+still produced from the exact factors (phase B) under the two-phase
+certificate, so centroid precision only moves recall, never
+correctness.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["lloyd_step", "train_centroids", "assign_cells"]
+
+
+@jax.jit
+def _sq_dist_argmin(points, centers):
+    """Nearest center per point by squared euclidean distance —
+    ||p||^2 is constant per point and dropped (argmin-invariant), so
+    the kernel is one matmul plus a per-center norm."""
+    d = (jnp.sum(centers * centers, axis=1)[None, :]
+         - 2.0 * jnp.matmul(points, centers.T,
+                            preferred_element_type=jnp.float32))
+    return jnp.argmin(d, axis=1).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("ncells",))
+def lloyd_step(points, centers, ncells: int):
+    """One Lloyd's iteration: assign every point to its nearest
+    center, then move each center to the mean of its points.  Empty
+    cells keep their previous center (a dead centroid simply owns no
+    rows — harmless to the partition invariant, and re-seeding would
+    make the build depend on iteration order)."""
+    idx = _sq_dist_argmin(points, centers)
+    one_hot = jax.nn.one_hot(idx, ncells, dtype=jnp.float32)
+    counts = jnp.sum(one_hot, axis=0)
+    sums = jnp.matmul(one_hot.T, points,
+                      preferred_element_type=jnp.float32)
+    new = sums / jnp.maximum(counts, 1.0)[:, None]
+    return jnp.where((counts > 0.0)[:, None], new, centers)
+
+
+def train_centroids(rows: np.ndarray, ncells: int, iterations: int,
+                    seed: int) -> np.ndarray:
+    """K-means centroids over ``rows`` (host or device float32), run
+    for ``iterations`` Lloyd steps from a seeded row-sample init.
+    Deterministic for fixed inputs: the init permutation comes from a
+    seeded Generator and every step is a jitted reduction, so the same
+    generation always trains the same partition."""
+    rows = np.asarray(rows, dtype=np.float32)
+    n = rows.shape[0]
+    if n == 0 or ncells < 1:
+        raise ValueError("cannot train centroids over an empty matrix")
+    ncells = min(ncells, n)
+    rng = np.random.default_rng(seed)
+    init = rows[rng.permutation(n)[:ncells]]
+    if ncells < 2:
+        return init
+    pts = jnp.asarray(rows)
+    centers = jnp.asarray(init)
+    for _ in range(max(1, iterations)):
+        centers = lloyd_step(pts, centers, ncells)
+    return np.asarray(jax.device_get(centers), dtype=np.float32)
+
+
+def assign_cells(vecs, centroids) -> np.ndarray:
+    """Nearest-centroid cell id per row of ``vecs`` — the full-catalog
+    assignment behind the IVF partition (one matmul-argmin dispatch,
+    however many rows).  ``vecs`` may be the store's lane-padded
+    device snapshot; centroids are zero-padded to match, which leaves
+    distances identical (padding lanes are exactly 0 on both sides)."""
+    c = jnp.asarray(centroids, dtype=jnp.float32)
+    w = int(vecs.shape[1])
+    if int(c.shape[1]) != w:
+        c = jnp.pad(c, ((0, 0), (0, w - int(c.shape[1]))))
+    return np.asarray(jax.device_get(
+        _sq_dist_argmin(vecs.astype(jnp.float32), c)), dtype=np.int32)

@@ -562,6 +562,96 @@ def test_check_regression_gateway_writes_cell_back_compat(tmp_path,
     assert not report["regressions"]
 
 
+def test_check_regression_gateway_ann_cell_gates_independently(
+        tmp_path, capsys):
+    """The r15 IVF-ANN rung (ISSUE 18, ``--ann``) gates as its own
+    pseudo-cell on the ANN door's sustained qps: an index-build or
+    routing regression — ANN silently failing closed serves correct
+    answers at exact-kernel speed, collapsing the number — fails the
+    gate even when the exact cells held; the recall certificate and
+    speedup ride along for diagnosis."""
+    prev = _gateway_doc([(50, 65536, 1, 100.0)])
+    prev["rows"][0]["ann"] = {
+        "open_loop_sustained_qps": 950.0,
+        "speedup_vs_exact": 8.3,
+        "certificate": {"recall": 0.988, "min_recall": 0.95},
+        "sustained_p99_ms": 41.0}
+    cur = _gateway_doc([(50, 65536, 1, 101.0)])
+    cur["rows"][0]["ann"] = {
+        "open_loop_sustained_qps": 120.0,   # fell back to exact speed
+        "speedup_vs_exact": 1.05,
+        "certificate": {"recall": 0.988, "min_recall": 0.95},
+        "sustained_p99_ms": 600.0}
+    rc = cr.main(["--kind", "gateway",
+                  "--previous", _write(tmp_path,
+                                       "BENCH_GATEWAY_r14.json", prev),
+                  "--current", _write(tmp_path,
+                                      "BENCH_GATEWAY_r15.json", cur)])
+    assert rc == 1
+    report = json.loads(capsys.readouterr().out)
+    assert [c["cell"] for c in report["regressions"]] == \
+        ["50f/0.065536M/1rep/ann"]
+    # the rung never sustaining (door down, every rung shed) zeroes
+    # the gated number: also a failure
+    cur["rows"][0]["ann"]["open_loop_sustained_qps"] = 0.0
+    rc = cr.main(["--kind", "gateway",
+                  "--previous", _write(tmp_path,
+                                       "BENCH_GATEWAY_r14.json", prev),
+                  "--current", _write(tmp_path,
+                                      "BENCH_GATEWAY_r15.json", cur)])
+    assert rc == 1
+    # and a healthy rung gates green
+    cur["rows"][0]["ann"]["open_loop_sustained_qps"] = 940.0
+    rc = cr.main(["--kind", "gateway",
+                  "--previous", _write(tmp_path,
+                                       "BENCH_GATEWAY_r14.json", prev),
+                  "--current", _write(tmp_path,
+                                      "BENCH_GATEWAY_r15.json", cur)])
+    assert rc == 0
+
+
+def test_check_regression_gateway_ann_cell_back_compat(tmp_path,
+                                                       capsys):
+    """r14-and-earlier artifacts carry no ANN rung — the pseudo-cell
+    is new, never gated; and a probe that WITHHELD its headline (ivf
+    never routed under emulation: the qps would be fantasy) drops the
+    cell entirely rather than gating a number no device produced."""
+    prev = _gateway_doc([(50, 65536, 1, 100.0)])           # r14 shape
+    cur = _gateway_doc([(50, 65536, 1, 99.0)])
+    cur["rows"][0]["ann"] = {
+        "open_loop_sustained_qps": 950.0,
+        "speedup_vs_exact": 8.3,
+        "certificate": {"recall": 0.988}}
+    rc = cr.main(["--kind", "gateway",
+                  "--previous", _write(tmp_path,
+                                       "BENCH_GATEWAY_r14.json", prev),
+                  "--current", _write(tmp_path,
+                                      "BENCH_GATEWAY_r15.json", cur)])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["new_cells"] == ["(50, 65536, 1, 1, 'ann')"]
+    assert not report["regressions"]
+    # headline withheld (None): the probe refused to certify a number
+    # (ivf never routed under emulation) — the cell drops out and is
+    # surfaced as MISSING, the same non-gating visibility every
+    # skipped rung gets, rather than gating a fantasy qps
+    prev2 = _gateway_doc([(50, 65536, 1, 100.0)])
+    prev2["rows"][0]["ann"] = dict(cur["rows"][0]["ann"])
+    cur2 = _gateway_doc([(50, 65536, 1, 99.0)])
+    cur2["rows"][0]["ann"] = {
+        "open_loop_sustained_qps": None,
+        "ann_door_qps_raw": 950.0, "ivf_routed": False}
+    rc = cr.main(["--kind", "gateway",
+                  "--previous", _write(tmp_path,
+                                       "BENCH_GATEWAY_r15a.json", prev2),
+                  "--current", _write(tmp_path,
+                                      "BENCH_GATEWAY_r15b.json", cur2)])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out)
+    assert "(50, 65536, 1, 1, 'ann')" in report["missing_cells"]
+    assert not report["regressions"]
+
+
 def test_check_regression_gateway_discovers_rounds_and_skips_cross_backend(
         tmp_path, capsys):
     _write(tmp_path, "BENCH_GATEWAY_r07.json",
