@@ -226,16 +226,18 @@ def apply_suppressions(findings: list[Finding],
 
 # populated lazily to keep core import-cycle-free
 PASS_NAMES = ("guarded-by", "async-blocking", "lock-order", "drift",
-              "sim-clock")
+              "sim-clock", "diagnose-catalog")
 
 
 def _registry() -> dict[str, Callable[[SourceModel], list[Finding]]]:
-    from . import async_blocking, drift, guarded, lock_order, sim_clock
+    from . import (async_blocking, diagnose_catalog, drift, guarded,
+                   lock_order, sim_clock)
     return {"guarded-by": guarded.run,
             "async-blocking": async_blocking.run,
             "lock-order": lock_order.run,
             "drift": drift.run,
-            "sim-clock": sim_clock.run}
+            "sim-clock": sim_clock.run,
+            "diagnose-catalog": diagnose_catalog.run}
 
 
 def run_passes(model: SourceModel,

@@ -39,6 +39,8 @@ import logging
 
 import numpy as np
 
+from ...common import clock as clockmod
+from ...obs import device_time as device_time_mod
 from ...resilience import faults
 
 __all__ = ["measure_routes"]
@@ -95,6 +97,7 @@ def measure_routes(model, batch: int | None = None,
     n_rows = int(vecs.shape[0])
     if n_rows == 0 or len(model.Y) == 0:
         return None
+    t_measure = clockmod.monotonic()
     features = model.features
     k = min(sm._pad_k(10), n_rows)
     big, chunk = sm._stream_plan(n_rows, sm._CHUNKED_BATCH)
@@ -286,4 +289,13 @@ def measure_routes(model, batch: int | None = None,
         "exact=%s lsh=%s", n_rows, features, route["path"],
         route["chosen"], route.get("use_lsh"), costs_exact,
         costs_lsh or None)
+    # device-time accounting (obs/device_time.py): the measurement
+    # sweep is device-execute dominated, and it competes with serving
+    # for the chip — book it under its own route-class so the busy
+    # fraction and /admin/tail attribute re-route storms honestly
+    acct = device_time_mod.process_accountant()
+    if acct is not None:
+        acct.note("measure", route.get("chosen"),
+                  getattr(model, "generation", None),
+                  clockmod.monotonic() - t_measure)
     return route

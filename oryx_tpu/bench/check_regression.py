@@ -37,7 +37,12 @@ Three artifact families share the machinery, selected by ``--kind``:
   docs/OBSERVABILITY.md) and a relative creep gate between
   same-backend rounds (default threshold 50% for this kind:
   nanosecond microbenches are box-noise-sensitive where qps cells
-  are not, and the absolute budget is the real contract).
+  are not, and the absolute budget is the real contract).  Since r16
+  the budget gates ``unsampled_recorder_armed`` — the full pipeline
+  with the flight recorder's rings fed (ISSUE 20), the worst
+  unsampled cell — falling back to ``unsampled_full_pipeline`` for
+  pre-r16 artifacts, which simply lack the cell in the relative
+  gate.
 
 Joins the two most recent rounds (by round number in the filename) on
 the cell key and exits non-zero when any cell's HEADLINE metric —
@@ -115,8 +120,11 @@ def compare_obs(prev: dict, cur: dict, threshold: float = 0.50,
         prev = {"microbench_ns_per_request": {}}
     p = prev.get("microbench_ns_per_request") or {}
     c = cur.get("microbench_ns_per_request") or {}
-    hot = c.get("unsampled_full_pipeline",
-                c.get("unsampled_begin_branch_current"))
+    # the budget gates the WORST unsampled cell the round measured:
+    # recorder-armed (r16) > full pipeline (r10) > tracer-only (r08)
+    hot = c.get("unsampled_recorder_armed",
+                c.get("unsampled_full_pipeline",
+                      c.get("unsampled_begin_branch_current")))
     if hot is None:
         report["regressions"].append(
             {"cell": "unsampled hot path",
@@ -128,7 +136,8 @@ def compare_obs(prev: dict, cur: dict, threshold: float = 0.50,
              "over_budget_ns": budget_ns,
              "detail": "single-digit-µs contract broken"})
     for key in ("unsampled_begin_branch_current",
-                "unsampled_full_pipeline"):
+                "unsampled_full_pipeline",
+                "unsampled_recorder_armed"):
         if key not in p or key not in c:
             continue
         old, new = float(p[key]), float(c[key])

@@ -80,7 +80,8 @@ from ..kafka import utils as kafka_utils
 from ..kafka.api import KeyMessage
 from ..kafka.inproc import InProcTopicProducer, resolve_broker
 from ..lambda_rt.metrics import MetricsRegistry
-from ..obs import engine_from_config, tracer_from_config
+from ..obs import (engine_from_config, flight_from_config,
+                   tracer_from_config)
 from ..obs.server import ObsServer
 from ..resilience import faults
 from ..resilience.policy import (CircuitBreaker, ResilientTopicProducer,
@@ -282,13 +283,24 @@ class MirrorLayer:
                                   self.slo_engine.burn_gauge)
             self.metrics.gauge_fn("slo_error_budget_remaining",
                                   self.slo_engine.budget_gauge)
+        # flight recorder (obs/flight.py; None until the config gate
+        # opens): a staleness page or link-fault in this region leaves
+        # a bundle on the mirror's own side door
+        self.flight = flight_from_config(config, "mirror", self.metrics,
+                                         slo=self.slo_engine)
+        if self.flight is not None and self.slo_engine is not None:
+            flight = self.flight
+            self.slo_engine.on_page = lambda name, st: flight.trigger(
+                "slo-page", {"objective": name,
+                             "burn_5m": st.get("burn_5m")})
         self.obs_server = ObsServer(config, self.metrics, self.tracer,
                                     extra_context={
                                         "region_info": self.status,
                                         # /admin/slo serves the
                                         # staleness objective's alert
                                         # state on the same side door
-                                        "slo": self.slo_engine})
+                                        "slo": self.slo_engine,
+                                        "flight": self.flight})
 
     # -- gauges --------------------------------------------------------------
 
@@ -528,6 +540,8 @@ class MirrorLayer:
 
     def close(self) -> None:
         self._stop.set()
+        if self.flight is not None:
+            self.flight.close()
         self.obs_server.close()
         if self._thread:
             self._thread.join(10.0)

@@ -45,11 +45,14 @@ from ..lambda_rt.http import HttpApp, Request, Route, TextResponse, \
     make_server
 from ..lambda_rt.metrics import MetricsRegistry
 from ..obs import (engine_from_config, events_from_config,
-                   merge_snapshots, render_openmetrics_blocks,
+                   flight_from_config, merge_snapshots,
+                   render_openmetrics_blocks,
                    render_prometheus_blocks, tracer_from_config)
-from ..obs.server import (OPENMETRICS_CTYPE, admin_profile,
-                          admin_region, admin_slo, admin_tail,
-                          admin_traces, own_prometheus_snapshot)
+from ..obs.server import (OPENMETRICS_CTYPE, admin_diagnose,
+                          admin_flight, admin_flight_dump,
+                          admin_profile, admin_region, admin_slo,
+                          admin_tail, admin_traces,
+                          own_prometheus_snapshot)
 from ..ops import als_fold_in
 from ..ops.solver import SingularMatrixSolverException, get_solver
 from ..resilience import faults
@@ -777,6 +780,15 @@ ROUTES = [
     # region identity: which active-active region answered — the
     # failover runbook's first probe (docs/SCALING.md "Multi-region")
     Route("GET", "/admin/region", admin_region),
+    # flight recorder + cluster auto-triage (obs/flight.py,
+    # obs/diagnose.py); /admin/flight 404s until the config gate opens,
+    # /admin/diagnose joins every live replica's surface via ?join=1
+    Route("GET", "/admin/flight", admin_flight),
+    Route("GET", "/admin/diagnose", admin_diagnose),
+    # mutating: writes a bundle to the store AND fans the dump
+    # cluster-wide when the trigger originates here
+    Route("POST", "/admin/flight/dump", admin_flight_dump,
+          mutates=True),
     # elastic-topology admin: reshard status + target declaration
     Route("GET", "/admin/topology", _topology_get),
     Route("POST", "/admin/topology", _topology_post, mutates=True),
@@ -870,6 +882,37 @@ class RouterLayer:
                                   self.slo_engine.budget_gauge)
         # wide-event request log (obs/events.py; None = disabled)
         self.events = events_from_config(config, "router", self.metrics)
+        if self.events is not None:
+            reg = self.metrics
+
+            def _event_context() -> dict:
+                # schema catch-up (PR 19): requests that served while
+                # the write path was shedding carry the cumulative count
+                n = int(reg.counters_snapshot().get("ingest_sheds", 0))
+                return {"ingest_sheds": n} if n else {}
+
+            self.events.context_fn = _event_context
+        # flight recorder (obs/flight.py; None until the config gate
+        # opens).  The router is the trigger fan-out root: its dump's
+        # trigger id rides a POST to every live ready replica over the
+        # scatter transport, so one page yields one correlated bundle
+        # per live process.
+        self.flight = flight_from_config(config, "router", self.metrics,
+                                         slo=self.slo_engine)
+        if self.flight is not None:
+            flight = self.flight
+            sg = self.scatter
+            flight.fan_out = lambda tid, reason: len(sg.scrape_replicas(
+                f"/admin/flight/dump?trigger={tid}&reason={reason}",
+                method="POST"))
+            if self.slo_engine is not None:
+                # page transition -> one debounced cluster-wide dump;
+                # the callback runs with the SLO lock held and
+                # trigger() never re-enters the engine
+                self.slo_engine.on_page = \
+                    lambda name, st: flight.trigger(
+                        "slo-page", {"objective": name,
+                                     "burn_5m": st.get("burn_5m")})
         self.input_producer = None
         self.input_breaker = CircuitBreaker.from_config(
             "router-input", config)
@@ -918,6 +961,7 @@ class RouterLayer:
                 "result_cache": self.result_cache,
                 "slo": self.slo_engine,
                 "events": self.events,
+                "flight": self.flight,
                 "yty_cache": {},
                 "yty_lock": threading.Lock(),
                 # /admin/region enrichment: the router's region answers
@@ -1056,6 +1100,8 @@ class RouterLayer:
         if self._server:
             self._server.shutdown()
         self.scatter.close()
+        if self.flight is not None:
+            self.flight.close()
         if self.events is not None:
             self.events.close()
         if self.input_producer:

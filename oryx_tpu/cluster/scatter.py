@@ -930,21 +930,24 @@ class ScatterGather:
         raise ShardUnavailable(f"no replica answered: {last}")
 
     def scrape_replicas(self, path: str,
-                        deadline: Deadline | None = None
+                        deadline: Deadline | None = None,
+                        method: str = "GET"
                         ) -> list[tuple[Heartbeat, dict]]:
-        """Best-effort GET against EVERY live ready replica — not one
-        per shard like ``scatter`` — returning ``(heartbeat, payload)``
-        for each 2xx JSON answer.  The cluster-wide metrics merge needs
-        every replica's histogram buckets; a replica that fails or
-        stalls is simply absent from the merge (the exposition reports
-        how many were scraped)."""
+        """Best-effort request against EVERY live ready replica — not
+        one per shard like ``scatter`` — returning ``(heartbeat,
+        payload)`` for each 2xx JSON answer.  The cluster-wide metrics
+        merge needs every replica's histogram buckets; a replica that
+        fails or stalls is simply absent from the merge (the
+        exposition reports how many were scraped).  ``method="POST"``
+        drives the cluster-wide control fan-outs (the flight
+        recorder's correlated dump) over the same transport."""
         candidates = self.registry.any_candidates()
         if not candidates:
             return []
         # scrapes are control plane, never trace roots: mark them
         # explicitly unsampled so replicas don't sample 1% of them
         futures = [(hb, self._exec.submit(self._attempt, hb, hb.shard,
-                                          "GET", path, None, deadline,
+                                          method, path, None, deadline,
                                           self._unsampled_tp))
                    for hb in candidates]
         out: list[tuple[Heartbeat, dict]] = []

@@ -21,7 +21,7 @@ from ..common.lang import load_instance
 from ..kafka import utils as kafka_utils
 from ..kafka.api import KeyMessage
 from ..kafka.inproc import InProcTopicProducer, resolve_broker
-from ..obs import freshness, tracer_from_config
+from ..obs import flight_from_config, freshness, tracer_from_config
 from ..obs.server import ObsServer
 from ..resilience import faults
 from . import data_store
@@ -70,8 +70,12 @@ class BatchLayer:
                                    self._group))
         self.metrics.gauge_fn("batch_generation_age_sec",
                               self._generation_age_sec)
+        # flight recorder (obs/flight.py; None until the config gate
+        # opens): a chaos fault or crash mid-generation leaves a bundle
+        self.flight = flight_from_config(config, "batch", self.metrics)
         self.obs_server = ObsServer(config, self.metrics,
-                                    tracer_from_config(config, "batch"))
+                                    tracer_from_config(config, "batch"),
+                                    extra_context={"flight": self.flight})
 
     def _generation_age_sec(self) -> float | None:
         t = self._last_generation_mono
@@ -101,6 +105,8 @@ class BatchLayer:
 
     def close(self) -> None:
         self._stop.set()
+        if self.flight is not None:
+            self.flight.close()
         self.obs_server.close()
         if self._thread:
             self._thread.join(10.0)

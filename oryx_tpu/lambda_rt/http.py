@@ -235,6 +235,9 @@ class HttpApp:
         # common request pays one attribute check plus the should_emit
         # comparisons when configured
         self.events = context.get("events")
+        # flight recorder (obs/flight.py): None = disabled; armed it
+        # costs one ring append per request in the finally block
+        self.flight = context.get("flight")
         self.read_only = read_only
         # optional admission controller (cluster/admission.py): gates
         # routes marked admission=True; absent = no per-request cost
@@ -398,6 +401,18 @@ class HttpApp:
                     self.events.emit(handler._oryx_route or "unmatched",
                                      handler._oryx_status, dur_ms,
                                      trace_id, spans)
+            if self.flight is not None:
+                # black-box ring append (obs/flight.py); sampled
+                # requests also feed the span ring.  observe_request
+                # is internally best-effort and can never raise
+                trace_id = handler._oryx_trace
+                spans = self.tracer.spans_for(trace_id) \
+                    if self.tracer is not None and trace_id else None
+                self.flight.observe_request(
+                    handler._oryx_route or "unmatched",
+                    handler._oryx_status,
+                    (time.perf_counter() - t0) * 1000.0,
+                    trace_id, spans)
 
     def _handle(self, handler: BaseHTTPRequestHandler) -> None:
         if not self._auth_ok(handler):

@@ -22,8 +22,9 @@ from ..common.config import Config
 from ..common.lang import load_instance, logging_call
 from ..kafka import utils as kafka_utils
 from ..kafka.inproc import InProcTopicProducer, resolve_broker
-from ..obs import (engine_from_config, events_from_config, freshness,
-                   tracer_from_config)
+from ..obs import (DeviceTimeAccountant, engine_from_config,
+                   events_from_config, flight_from_config, freshness,
+                   install_process_accountant, tracer_from_config)
 from ..resilience import faults
 from ..resilience.policy import (CircuitBreaker, ResilientTopicProducer,
                                  Retry, run_with_resubscribe)
@@ -122,12 +123,17 @@ class ServingLayer:
         # the request span starts at the HTTP dispatcher, the batcher
         # splits queue-wait from device-execute under it
         self.tracer = tracer_from_config(config, "serving")
+        self.metrics = MetricsRegistry()
+        # continuous device-time accounting (obs/device_time.py): the
+        # batcher books serve-class execute brackets, the kernel router
+        # books measure-class sweeps via the process-level hook
+        self.device_time = DeviceTimeAccountant(self.metrics)
+        install_process_accountant(self.device_time)
         self.top_n_batcher = TopNBatcher(
             max_batch=config.get_int(f"{api}.max-batch"),
             pipeline=config.get_int(f"{api}.scoring-pipeline-depth"),
             idle_wait_s=None if idle_ms < 0 else idle_ms / 1000.0,
-            tracer=self.tracer)
-        self.metrics = MetricsRegistry()
+            tracer=self.tracer, accountant=self.device_time)
         if self.cluster_enabled:
             # replica-side exact result cache for /shard/* answers
             # (cluster/result_cache.py ShardResultCache; off by
@@ -183,6 +189,30 @@ class ServingLayer:
                                   self.slo_engine.budget_gauge)
         # wide-event request log (obs/events.py; None = disabled)
         self.events = events_from_config(config, "serving", self.metrics)
+        if self.events is not None and hasattr(self.model_manager,
+                                               "model_load_s"):
+            # schema catch-up (PR 18): a request that served while the
+            # ANN index had failed closed carries the fallback count
+            mgr = self.model_manager
+
+            def _event_context() -> dict:
+                n = int(getattr(mgr, "ann_index_fallbacks", 0) or 0)
+                return {"ann_index_fallbacks": n} if n else {}
+
+            self.events.context_fn = _event_context
+        # flight recorder (obs/flight.py; None until oryx.obs.flight.dir
+        # opens the gate): black-box rings + anomaly-triggered bundles
+        self.flight = flight_from_config(
+            config, "serving", self.metrics, slo=self.slo_engine,
+            accountant=self.device_time)
+        if self.flight is not None and self.slo_engine is not None:
+            flight = self.flight
+            # page transition -> one debounced local bundle; the
+            # callback runs with the SLO lock held and trigger() never
+            # re-enters the engine (bundle reads last_status, lock-free)
+            self.slo_engine.on_page = lambda name, st: flight.trigger(
+                "slo-page", {"objective": name,
+                             "burn_5m": st.get("burn_5m")})
         self.app = HttpApp(
             routes,
             context={
@@ -196,6 +226,8 @@ class ServingLayer:
                 "tracer": self.tracer,
                 "slo": self.slo_engine,
                 "events": self.events,
+                "flight": self.flight,
+                "device_time": self.device_time,
             },
             read_only=self.read_only,
             user_name=self.user_name,
@@ -346,6 +378,8 @@ class ServingLayer:
         if self._server:
             self._server.shutdown()
         self.top_n_batcher.close()
+        if self.flight is not None:
+            self.flight.close()
         if self.events is not None:
             self.events.close()
         self.model_manager.close()

@@ -50,7 +50,8 @@ from ..common.lang import load_instance, logging_call
 from ..kafka import utils as kafka_utils
 from ..kafka.api import KEY_UP, KeyMessage
 from ..kafka.inproc import InProcTopicProducer, resolve_broker
-from ..obs import freshness, tracer_from_config
+from ..obs import (events_from_config, flight_from_config, freshness,
+                   tracer_from_config)
 from ..obs.server import ObsServer
 from ..obs.trace import parse_traceparent
 from ..resilience import faults
@@ -128,7 +129,21 @@ class SpeedLayer:
         if self.checkpoint is not None:
             self.metrics.gauge_fn("speed_checkpoint_age_sec",
                                   self._checkpoint_age_sec)
-        self.obs_server = ObsServer(config, self.metrics, self.tracer)
+        # wide-event log (obs/events.py; None = disabled): the speed
+        # tier's side-door requests carry the shard coordinate so a
+        # cluster-merged event stream attributes lines to the worker
+        self.events = events_from_config(
+            config, "speed", self.metrics,
+            static_fields={"speed_shard": self.shard_tag})
+        # flight recorder (obs/flight.py; None until the config gate
+        # opens): a chaos fault or crash in this worker leaves a bundle
+        # even though the tier serves no public HTTP
+        self.flight = flight_from_config(config, "speed", self.metrics)
+        self.obs_server = ObsServer(config, self.metrics, self.tracer,
+                                    extra_context={
+                                        "events": self.events,
+                                        "flight": self.flight,
+                                    })
 
     def _checkpoint_age_sec(self) -> float | None:
         """Seconds since the durable fence last advanced; None until the
@@ -184,6 +199,10 @@ class SpeedLayer:
             if t:
                 t.join(10.0)
         self.model_manager.close()
+        if self.flight is not None:
+            self.flight.close()
+        if self.events is not None:
+            self.events.close()
         self.obs_server.close()
         self._producer.close()
 

@@ -9,11 +9,22 @@
   (/admin/slo, the autoscaler's SLO pressure signal)
 - ``events``  — wide-event JSONL request log, size-rotated, durable
 - ``profile`` — on-demand ``jax.profiler`` capture
+- ``flight``  — anomaly-triggered black-box flight recorder (bounded
+  rings, trigger-correlated JSON bundles)
+- ``device_time`` — continuous per-route device-execute accounting
+  (``device_busy_fraction``)
+- ``diagnose`` — pure rule engine ranking likely causes over the
+  catalogued metric surface (/admin/diagnose)
 - ``server``  — shared /metrics + /admin/* resources and the headless
   tiers' side-door metrics server
 """
 
+from .device_time import (DeviceTimeAccountant, install_process_accountant,
+                          process_accountant)
+from .diagnose import (build_surface, diagnose, diagnose_bundle,
+                       merge_surfaces, surface_from_bundle)
 from .events import events_from_config
+from .flight import FlightRecorder, flight_from_config
 from .prom import (LATENCY_BUCKETS_MS, Histogram, bucket_quantile,
                    merge_histograms, merge_snapshots,
                    render_openmetrics, render_openmetrics_blocks,
@@ -28,4 +39,7 @@ __all__ = ["LATENCY_BUCKETS_MS", "Histogram", "bucket_quantile",
            "render_openmetrics_blocks", "NOOP_SPAN", "Span",
            "Tracer", "format_traceparent", "parse_traceparent",
            "tracer_from_config", "engine_from_config",
-           "events_from_config"]
+           "events_from_config", "FlightRecorder", "flight_from_config",
+           "DeviceTimeAccountant", "install_process_accountant",
+           "process_accountant", "build_surface", "diagnose",
+           "diagnose_bundle", "merge_surfaces", "surface_from_bundle"]

@@ -38,10 +38,15 @@ __all__ = ["FIELDS", "WideEventLog", "events_from_config"]
 
 # the wide-event schema, linted against docs/OBSERVABILITY.md; lines
 # carry a subset (a router line has shard fields, a replica line has
-# batcher fields, an unsampled error line has neither)
+# batcher fields, an unsampled error line has neither).  The tail
+# three are the PR 18/19 catch-up: kernel_route gained the ``ann``
+# value, ann_index_fallbacks/ingest_sheds ride as context fields
+# (context_fn), and speed_shard stamps the sharded speed side-door's
+# lines (static_fields)
 FIELDS = ("ts_ms", "route", "status", "latency_ms", "trace_id",
           "sampled", "queue_wait_ms", "batch_size", "kernel_route",
-          "shards_called", "shard_errors", "shards_merged")
+          "shards_called", "shard_errors", "shards_merged",
+          "ann_index_fallbacks", "ingest_sheds", "speed_shard")
 
 
 def _derive_span_fields(spans) -> dict:
@@ -82,7 +87,8 @@ class WideEventLog:
 
     def __init__(self, directory: str, service: str,
                  max_bytes: int = 16 * 1024 * 1024, max_files: int = 4,
-                 always_slow_ms: int | None = None, registry=None):
+                 always_slow_ms: int | None = None, registry=None,
+                 static_fields: dict | None = None):
         os.makedirs(directory, exist_ok=True)
         self.path = os.path.join(
             directory, f"events-{service}-{os.getpid()}.jsonl")
@@ -90,6 +96,14 @@ class WideEventLog:
         self.max_files = max(1, int(max_files))
         self.always_slow_ms = always_slow_ms
         self._registry = registry
+        # per-process identity stamped on every line (the speed tier's
+        # speed_shard id); merged before the context fn so dynamic
+        # context can never clobber identity
+        self.static_fields = dict(static_fields or {})
+        # tier-wired callable -> extra fields for the CURRENT line
+        # (serving adds ann_index_fallbacks, the router adds
+        # ingest_sheds); best-effort, evaluated only on emitted lines
+        self.context_fn = None
         self._lock = threading.Lock()
         self._f = None
         self._size = 0
@@ -125,6 +139,14 @@ class WideEventLog:
             else:
                 event["sampled"] = False
             event.update(_derive_span_fields(spans))
+            fn = self.context_fn
+            if fn is not None:
+                try:
+                    event.update(fn() or {})
+                except Exception:  # noqa: BLE001 — context is best-effort
+                    pass
+            if self.static_fields:
+                event.update(self.static_fields)
             line = json.dumps(event, separators=(",", ":")) + "\n"
             data = line.encode("utf-8")
             with self._lock:
@@ -189,8 +211,9 @@ class WideEventLog:
                 self._f = None
 
 
-def events_from_config(config, service: str,
-                       registry=None) -> WideEventLog | None:
+def events_from_config(config, service: str, registry=None,
+                       static_fields: dict | None = None
+                       ) -> WideEventLog | None:
     """Build the tier's event log from ``oryx.obs.events.*``; None when
     no directory is configured (the dispatcher then pays one attribute
     check per request)."""
@@ -203,4 +226,4 @@ def events_from_config(config, service: str,
         max_bytes=config.get_int(f"{base}.max-bytes"),
         max_files=config.get_int(f"{base}.max-files"),
         always_slow_ms=config.get_optional_int(f"{base}.always-slow-ms"),
-        registry=registry)
+        registry=registry, static_fields=static_fields)

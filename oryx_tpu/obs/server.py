@@ -33,9 +33,10 @@ from .prom import render_openmetrics, render_prometheus
 _log = logging.getLogger(__name__)
 
 __all__ = ["admin_traces", "admin_tail", "admin_slo", "admin_profile",
-           "admin_region", "registry_metrics", "own_prometheus_snapshot",
-           "prometheus_response", "gather_traces", "ObsServer",
-           "OPENMETRICS_CTYPE"]
+           "admin_region", "admin_flight", "admin_flight_dump",
+           "admin_diagnose", "registry_metrics",
+           "own_prometheus_snapshot", "prometheus_response",
+           "gather_traces", "ObsServer", "OPENMETRICS_CTYPE"]
 
 # the OpenMetrics media type a conforming scraper negotiates for
 OPENMETRICS_CTYPE = ("application/openmetrics-text; version=1.0.0; "
@@ -94,6 +95,9 @@ def registry_metrics(req: Request):
     tracer = req.context.get("tracer")
     if tracer is not None:
         out["obs"] = {"trace_record_failures": tracer.record_failures}
+    acct = req.context.get("device_time")
+    if acct is not None:
+        out["device_time"] = acct.snapshot()
     return out
 
 
@@ -173,6 +177,13 @@ def admin_tail(req: Request):
     report["service"] = tracer.service
     if joined is not None:
         report["joined_replicas"] = joined
+    acct = req.context.get("device_time")
+    if acct is not None:
+        # device occupancy alongside the stage taxonomy: the
+        # serving.device_execute stage says how long requests waited
+        # on compute, this block says WHICH kernel route owned the
+        # device over the accounting window
+        report["device_time"] = acct.snapshot()
     return report
 
 
@@ -219,15 +230,85 @@ def admin_profile(req: Request):
         raise OryxServingException(503, str(e)) from e
 
 
+def admin_flight(req: Request):
+    """The flight recorder's status: ring occupancy, dump counts, the
+    last bundle published (obs/flight.py)."""
+    flight = req.context.get("flight")
+    if flight is None:
+        raise OryxServingException(
+            404, "flight recorder not enabled (oryx.obs.flight.dir)")
+    return flight.status()
+
+
+def admin_flight_dump(req: Request):
+    """Manual trigger: snapshot the rings into a bundle NOW.  On the
+    router a locally-originated dump fans the trigger id out to every
+    live replica over the framed transport (the recorder's wired
+    ``fan_out``); a fanned-in call carries ``?trigger=<id>`` and never
+    re-fans.  Debounced and deduped exactly like automatic
+    triggers."""
+    flight = req.context.get("flight")
+    if flight is None:
+        raise OryxServingException(
+            404, "flight recorder not enabled (oryx.obs.flight.dir)")
+    return flight.trigger(req.q1("reason", "manual"),
+                          detail={"source": "admin"},
+                          trigger_id=req.q1("trigger", None))
+
+
+def admin_diagnose(req: Request):
+    """Auto-triage (obs/diagnose.py): evaluate the rule engine over
+    this process's metric surface and return a ranked cause list with
+    runbook anchors.  On the router, ``?join=1`` (the default there —
+    any tier without a scatter path ignores it) scrapes every live
+    replica's surface and diagnoses the cluster-merged view."""
+    # NOTE: `from . import diagnose` would resolve to the *function*
+    # the package __init__ re-exports over the submodule of the same
+    # name — import the callables, not the shadowed module object
+    from .diagnose import build_surface, diagnose, merge_surfaces
+    registry = req.context.get("metrics")
+    if registry is None:
+        raise OryxServingException(404, "metrics not enabled")
+    engine = req.context.get("slo")
+    acct = req.context.get("device_time")
+    surface = build_surface(
+        registry=registry,
+        slo_status=engine.last_status() if engine is not None else None,
+        resilience=resilience_snapshot(),
+        device=acct.snapshot() if acct is not None else None)
+    sg = req.context.get("scatter")
+    joined = None
+    if sg is not None and _wants_join(req, "1"):
+        surfaces = [surface]
+        joined = 0
+        for _, payload in sg.scrape_replicas(
+                "/admin/diagnose?join=0", deadline=req.deadline):
+            replica_surface = payload.get("surface")
+            if isinstance(replica_surface, dict):
+                surfaces.append(replica_surface)
+                joined += 1
+        surface = merge_surfaces(surfaces)
+    out = diagnose(surface)
+    out["surface"] = surface
+    if joined is not None:
+        out["joined_replicas"] = joined
+    return out
+
+
 OBS_ROUTES = [
     Route("GET", "/metrics", registry_metrics),
     Route("GET", "/admin/traces", admin_traces),
     Route("GET", "/admin/tail", admin_tail),
     Route("GET", "/admin/slo", admin_slo),
     Route("GET", "/admin/region", admin_region),
+    Route("GET", "/admin/flight", admin_flight),
+    Route("GET", "/admin/diagnose", admin_diagnose),
     # mutating: captures device state to disk — read-only mode and
     # DIGEST auth (when configured) both gate it
     Route("GET", "/admin/profile", admin_profile, mutates=True),
+    # mutating for the same reason: writes a bundle to the store
+    Route("POST", "/admin/flight/dump", admin_flight_dump,
+          mutates=True),
 ]
 
 

@@ -32,6 +32,7 @@ worked example).
 
 from __future__ import annotations
 
+import json
 import threading
 from collections import deque
 
@@ -184,6 +185,12 @@ class SloEngine:
         self.resolution_sec = float(resolution_sec)
         self._clock = clock
         self.eval_failures = 0
+        # page-transition callback, set at wiring time (the flight
+        # recorder's trigger).  Invoked WITH the engine lock held —
+        # the callback must never call back into evaluate()/status()/
+        # burn_gauge() (the lock is non-reentrant); the objective's
+        # state dict is passed directly instead.
+        self.on_page = None
         self._lock = threading.Lock()
         # (t, {objective: (good, total)}) — bounded to the longest
         # window plus one resolution step
@@ -290,6 +297,13 @@ class SloEngine:
             if state != st["state"]:
                 st["transitions"] += 1
                 st["since"] = round(now, 3)
+                if state == "page":
+                    cb = self.on_page
+                    if cb is not None:
+                        try:
+                            cb(o.name, {**st, "state": state})
+                        except Exception:  # noqa: BLE001 — best-effort hook
+                            pass
             st["state"] = state
             st["windows"] = windows
             st["fast_burn"] = fast
@@ -320,6 +334,18 @@ class SloEngine:
         rem = [o.get("error_budget_remaining", 1.0)
                for o in status["objectives"].values()]
         return min(rem) if rem else 1.0
+
+    def last_status(self) -> dict:
+        """The most recently computed status, WITHOUT evaluating —
+        lock-free on purpose: the flight recorder reads this from
+        inside the page callback (where the engine lock is held) and
+        from fault listeners that may interleave with evaluation.  A
+        torn read costs one slightly-stale field in a forensic
+        bundle, never a deadlock."""
+        try:
+            return json.loads(json.dumps(self._status, default=str))
+        except Exception:  # noqa: BLE001 — forensics are best-effort
+            return {}
 
     def status(self) -> dict:
         """The ``/admin/slo`` view."""

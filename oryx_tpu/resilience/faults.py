@@ -52,6 +52,7 @@ _log = logging.getLogger(__name__)
 
 __all__ = ["InjectedFault", "InjectedCrash", "FaultSpec", "inject",
            "clear", "fire", "fired", "release",
+           "add_fire_listener", "remove_fire_listener",
            "configure_from_config"]
 
 
@@ -92,6 +93,10 @@ _FIRED: dict[str, int] = {}
 _ACTIVE = False
 # configure_from_config arms once per process (see its docstring)
 _CONFIG_APPLIED = False
+# observers notified on every CONSUMED activation (the flight
+# recorder's "any chaos fault fired" trigger); a copy-on-write tuple
+# so fire() reads it without the lock
+_LISTENERS: tuple = ()
 
 
 def inject(point: str, mode: str = "error", times: int | None = 1,
@@ -137,6 +142,24 @@ def release(point: str) -> None:
         gate.set()
 
 
+def add_fire_listener(fn) -> None:
+    """Register ``fn(point, mode)`` to observe every consumed fault
+    activation.  Called after the spec is consumed and the registry
+    lock released, BEFORE the fault's action runs — so a crash-mode
+    fault is observed (and black-box captured) before it kills the
+    layer.  A raising listener is swallowed: observers must never
+    alter seam behavior."""
+    global _LISTENERS
+    with _LOCK:
+        _LISTENERS = _LISTENERS + (fn,)
+
+
+def remove_fire_listener(fn) -> None:
+    global _LISTENERS
+    with _LOCK:
+        _LISTENERS = tuple(f for f in _LISTENERS if f is not fn)
+
+
 def fire(point: str,
          error: Callable[[], BaseException] | None = None) -> str | None:
     """Consume one activation of ``point`` if armed.
@@ -163,6 +186,11 @@ def fire(point: str,
         factory = spec.error or error
         gate = spec.gate
     _log.info("Fault fired: %s mode=%s", point, mode)
+    for listener in _LISTENERS:
+        try:
+            listener(point, mode)
+        except Exception:  # noqa: BLE001 — observers never alter the seam
+            pass
     if mode == "delay":
         clockmod.sleep(delay)
         return None

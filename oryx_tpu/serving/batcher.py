@@ -72,7 +72,8 @@ class TopNBatcher:
     and each drain groups jobs by model identity."""
 
     def __init__(self, max_batch: int = 1024, pipeline: int = 32,
-                 idle_wait_s: float | None = None, tracer=None):
+                 idle_wait_s: float | None = None, tracer=None,
+                 accountant=None):
         """``pipeline`` dispatcher threads keep that many batched device
         calls in flight at once: dispatch latency (dominated by the
         host<->device round trip) overlaps instead of serializing, so
@@ -94,9 +95,16 @@ class TopNBatcher:
         ``tracer`` (obs/trace.py, or None) splits each sampled
         request's batcher residence into a queue-wait span and a
         device-execute span — the evidence that separates "the device
-        is slow" from "the queue is deep"."""
+        is slow" from "the queue is deep".
+
+        ``accountant`` (obs/device_time.py, or None) books every
+        batched device-execute bracket as route-class ``serve`` time
+        against the model's kernel route and generation — the
+        continuous occupancy accounting behind
+        ``device_busy_fraction``."""
         self.max_batch = max_batch
         self._tracer = tracer
+        self._accountant = accountant
         self._idle_wait = idle_wait_s
         self._cond = threading.Condition()
         self._pending: list[_Job] = []
@@ -415,6 +423,15 @@ class TopNBatcher:
                 for j in group:
                     j.error = e
             next_exec_start = clockmod.monotonic()
+            if self._accountant is not None:
+                # continuous occupancy: the same bracket the
+                # device_execute span measures, booked as serve-class
+                # device time against the model's route + generation
+                self._accountant.note(
+                    "serve",
+                    getattr(model, "kernel_route_label", None),
+                    getattr(model, "generation", None),
+                    next_exec_start - t_exec)
             if self._tracer is not None:
                 self._record_spans(group, t_exec, next_exec_start,
                                    status)

@@ -16,8 +16,10 @@ from ..common import clock as clockmod
 from ..api.serving import OryxServingException
 from ..lambda_rt.http import (HtmlResponse, Request, Route, TextResponse,
                               render_error_page)
-from ..obs.server import (admin_profile, admin_region, admin_slo,
-                          admin_tail, admin_traces, prometheus_response)
+from ..obs.server import (admin_diagnose, admin_flight,
+                          admin_flight_dump, admin_profile,
+                          admin_region, admin_slo, admin_tail,
+                          admin_traces, prometheus_response)
 from ..resilience.policy import CircuitOpenError, resilience_snapshot
 
 __all__ = ["ROUTES", "get_serving_model", "send_input",
@@ -191,6 +193,11 @@ def _metrics(req: Request):
     tracer = req.context.get("tracer")
     if tracer is not None:
         out["obs"] = {"trace_record_failures": tracer.record_failures}
+    # continuous device-time accounting (obs/device_time.py): which
+    # kernel route owned the device, and how busy it is
+    acct = req.context.get("device_time")
+    if acct is not None:
+        out["device_time"] = acct.snapshot()
     return out
 
 
@@ -205,7 +212,14 @@ ROUTES = [
     Route("GET", "/admin/slo", admin_slo),
     # region identity (multi-region serving, docs/SCALING.md)
     Route("GET", "/admin/region", admin_region),
+    # flight recorder + auto-triage (obs/flight.py, obs/diagnose.py);
+    # /admin/flight 404s until oryx.obs.flight.dir opens the gate
+    Route("GET", "/admin/flight", admin_flight),
+    Route("GET", "/admin/diagnose", admin_diagnose),
     # mutating: captures device state to disk — read-only mode and
     # DIGEST auth (when configured) both gate it
     Route("GET", "/admin/profile", admin_profile, mutates=True),
+    # mutating for the same reason: writes a bundle to the store
+    Route("POST", "/admin/flight/dump", admin_flight_dump,
+          mutates=True),
 ]

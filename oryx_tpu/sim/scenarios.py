@@ -369,11 +369,156 @@ def run_ingest_overload(seed: int, speed_shards: int = 2,
     return _run("ingest-overload", seed, keep_trace, body)
 
 
+# -- scenario: SLO page -> flight dump -> auto-triage ------------------------
+
+def _flight_monitor(cx: SimCluster, mirror_name: str, reg, engine,
+                    flight):
+    """The alerting sidecar, cooperatively scheduled: bridge the
+    mirror's staleness surface into the host registry, feed the flight
+    recorder's tick ring, evaluate the SLO engine.  Ordering matters —
+    the tick lands BEFORE evaluate(), so the bundle a page snapshots
+    carries the gauge reading that paged."""
+    last_link = 0
+    while True:
+        yield Sleep(0.05)
+        m = cx.live.get(mirror_name)
+        if m is not None:
+            stale = m.layer.metrics.gauge_value(
+                "cross_region_staleness_ms")
+            if stale is not None:
+                reg.set_gauge("cross_region_staleness_ms",
+                              float(stale))
+            link = m.layer.link_failures
+            if link > last_link:
+                reg.inc("mirror_link_failures", link - last_link)
+                last_link = link
+        flight.observe_request("GET /sim/probe", 200, 1.0)
+        engine.evaluate()
+
+
+def run_slo_page_flight(seed: int, ops: int = 18,
+                        horizon: float = 6.0,
+                        keep_trace: bool = False) -> SimResult:
+    """The ISSUE 20 diagnosis loop, end to end and deterministic: an
+    un-healed replication-link cut stalls one mirror, its staleness
+    gauge burns a kind=gauge SLO objective into ``page``, the page
+    callback triggers a flight dump, and the bundle's embedded
+    diagnosis must rank the injected cause (``mirror-stalled``)
+    first.  A second trigger inside the debounce window must be
+    counted and dropped, not dumped.  The SLO engine runs on a scaled
+    sim clock (1 virtual s = 720 SLO-s) and the recorder on the raw
+    sim clock, so every seed replays to the same trace hash."""
+    import json as jsonmod
+    import os as osmod
+
+    from ..lambda_rt.metrics import MetricsRegistry
+    from ..obs.diagnose import diagnose_bundle
+    from ..obs.flight import FlightRecorder
+    from ..obs.slo import SloEngine, SloObjective
+
+    def body(cx: SimCluster):
+        rng = cx.rng
+        for r in ("A", "B"):
+            cx.add_region(r)
+            cx.add_replica_fleet(r, 2, 1)
+        cx.publish_model("A")
+        cx.add_mirror("A", source_region="B")
+        cx.add_mirror("B", source_region="A")
+        for r in ("A", "B"):
+            cx.add_client(r, 0, ops, ENTITIES)
+        # the alerting sidecar: host registry + gauge-kind objective
+        # over the bridged staleness reading + armed recorder
+        reg = MetricsRegistry()
+        scale = 720.0  # 1 virtual s = 720 SLO-s: a 5m window is 0.42s
+        engine = SloEngine(
+            [SloObjective("staleness", kind="gauge", target=0.9,
+                          gauge="cross_region_staleness_ms",
+                          max_value=500.0)],
+            reg, fast_burn=5.0, slow_burn=3.0, resolution_sec=15.0,
+            clock=lambda: cx.clock.monotonic() * scale)
+        fdir = osmod.path.join(cx.checkpoint_dir("A"), "flight")
+        flight = FlightRecorder(
+            "sim", reg, dir=fdir, slo=engine,
+            diagnose_fn=diagnose_bundle,
+            tick_sec=0.05, debounce_sec=3.0, dump_on_exit=False,
+            clock=cx.clock.monotonic, wall=cx.clock.time)
+
+        def on_page(name, st):
+            cx.stats["slo_pages"] += 1
+            cx.sched.note(f"slo.page|{name}")
+            flight.trigger("slo-page", {"objective": name})
+
+        engine.on_page = on_page
+        try:
+            # the injected cause: cut B.mirror off its source and do
+            # NOT heal — staleness must climb until the page fires
+            t_cut = rng.uniform(0.8, 1.4)
+            forced = [FaultAction(t_cut, "cut", "B.mirror",
+                                  "A.broker")]
+            # flavor chaos on the OTHER replication direction only:
+            # the paging path itself stays deterministic
+            extra = random_schedule(
+                rng, horizon, n=1 + rng.randrange(2),
+                components=[], links=[("A.mirror", "B.broker")],
+                allow=("delay", "duplicate"))
+            sched = FaultSchedule(forced + extra.actions)
+            cx.sched.spawn("fault-driver", sched.driver(cx))
+            cx.sched.spawn("slo-monitor",
+                           _flight_monitor(cx, "B.mirror", reg,
+                                           engine, flight))
+            cx.await_condition(
+                lambda: cx.stats.get("slo_pages", 0) >= 1, horizon,
+                f"staleness SLO never paged after the {t_cut:.2f}s "
+                f"link cut")
+            if flight.dumps != 1:
+                raise InvariantViolation(
+                    "flight", f"page produced {flight.dumps} bundles "
+                    f"(want exactly 1)")
+            names = sorted(n for n in osmod.listdir(fdir)
+                           if n.endswith(".json"))
+            if len(names) != 1:
+                raise InvariantViolation(
+                    "flight", f"bundle dir holds {names} "
+                    f"(want exactly one published bundle)")
+            with open(osmod.path.join(fdir, names[0]),
+                      encoding="utf-8") as fh:
+                bundle = jsonmod.load(fh)
+            if bundle.get("trigger_reason") != "slo-page":
+                raise InvariantViolation(
+                    "flight", f"bundle trigger_reason="
+                    f"{bundle.get('trigger_reason')!r} "
+                    f"(want 'slo-page')")
+            causes = (bundle.get("diagnosis") or {}).get("causes") \
+                or []
+            if not causes or causes[0]["cause"] != "mirror-stalled":
+                raise InvariantViolation(
+                    "triage", "diagnosis did not rank the injected "
+                    f"cause first: {[c['cause'] for c in causes]}")
+            cx.stats["diagnosis_top_mirror_stalled"] = 1
+            # a page storm inside the debounce window collapses: the
+            # second trigger is counted, never dumped
+            res = flight.trigger("slo-page-repeat")
+            if not res.get("debounced"):
+                raise InvariantViolation(
+                    "flight", f"trigger inside the debounce window "
+                    f"was not debounced: {res}")
+            cx.stats["flight_debounced"] = \
+                int(reg.counters_snapshot().get(
+                    "flight_trigger_debounced", 0))
+            cx.sched.run_until(horizon)
+        finally:
+            flight.close()
+        cx.quiesce()
+
+    return _run("slo-page-flight", seed, keep_trace, body)
+
+
 SCENARIOS = {
     "mirror-partition": run_mirror_partition,
     "reshard-cutover": run_reshard_cutover,
     "speed-shard-crash": run_speed_shard_crash,
     "ingest-overload": run_ingest_overload,
+    "slo-page-flight": run_slo_page_flight,
 }
 
 

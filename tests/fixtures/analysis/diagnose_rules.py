@@ -1,0 +1,27 @@
+"""Seeded diagnose-catalog defects: a diagnosis rule reading a metric
+with no fixture OBSERVABILITY.md row, and a flight bundle field the
+catalog never documents (each next to a catalogued negative that must
+stay quiet).  NEVER imported — scanned as AST by
+tests/test_static_analysis.
+"""
+
+from oryx_tpu.obs.diagnose import Rule
+
+BUNDLE_FIELDS = (
+    "trigger_id",               # catalogued — no finding
+    "fixture_ghost_field",      # SEEDED: no OBSERVABILITY.md row
+)
+
+RULES = (
+    Rule("fixture-ok",
+         reads=("fixture_catalogued_counter",
+                "fixture_catalogued_gauge"),
+         runbook="docs/OBSERVABILITY.md#nowhere",
+         summary="catalogued reads — no finding",
+         check=lambda surface: None),
+    Rule("fixture-stale-read",
+         reads=("fixture_renamed_away_counter",),  # SEEDED: uncatalogued
+         runbook="docs/OBSERVABILITY.md#nowhere",
+         summary="reads a metric the catalog no longer names",
+         check=lambda surface: None),
+)

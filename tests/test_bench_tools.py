@@ -673,11 +673,14 @@ def test_check_regression_gateway_discovers_rounds_and_skips_cross_backend(
 
 # -- --kind obs: the observability overhead gate (ISSUE 7) --------------------
 
-def _obs_doc(unsampled_ns, full_ns=None, backend="cpu"):
+def _obs_doc(unsampled_ns, full_ns=None, armed_ns=None,
+             backend="cpu"):
     micro = {"unsampled_begin_branch_current": unsampled_ns,
              "sampled_begin_record_end": unsampled_ns * 6}
     if full_ns is not None:
         micro["unsampled_full_pipeline"] = full_ns
+    if armed_ns is not None:
+        micro["unsampled_recorder_armed"] = armed_ns
     return {"metric": "obs_tracing_overhead", "backend": backend,
             "microbench_ns_per_request": micro}
 
@@ -738,6 +741,61 @@ def test_check_regression_obs_discovers_rounds(tmp_path, capsys):
     report = json.loads(capsys.readouterr().out)
     assert report["previous"] == "BENCH_OBS_OVERHEAD_r08.json"
     assert report["current"] == "BENCH_OBS_OVERHEAD_r10.json"
+
+
+def test_check_regression_obs_recorder_armed_cell_gates_budget(
+        tmp_path, capsys):
+    # r16 (ISSUE 20): the recorder-armed cell is the WORST unsampled
+    # cell, so the hard budget gates on it — a healthy full_pipeline
+    # number cannot hide an over-budget armed recorder
+    rc = cr.main(["--kind", "obs",
+                  "--previous", _write(tmp_path,
+                                       "BENCH_OBS_OVERHEAD_r10.json",
+                                       _obs_doc(2000, full_ns=3000)),
+                  "--current", _write(tmp_path,
+                                      "BENCH_OBS_OVERHEAD_r16.json",
+                                      _obs_doc(2100, full_ns=3100,
+                                               armed_ns=12_000))])
+    assert rc == 1
+    report = json.loads(capsys.readouterr().out)
+    assert any(c.get("over_budget_ns") == 10_000
+               and c.get("ns_cur") == 12_000
+               for c in report["regressions"])
+
+
+def test_check_regression_obs_recorder_armed_pre_r16_back_compat(
+        tmp_path, capsys):
+    # a pre-r16 previous round simply lacks the recorder-armed cell:
+    # the relative gate skips it (never a phantom regression), the
+    # budget still gates the current round's armed number
+    rc = cr.main(["--kind", "obs",
+                  "--previous", _write(tmp_path,
+                                       "BENCH_OBS_OVERHEAD_r10.json",
+                                       _obs_doc(2000, full_ns=3000)),
+                  "--current", _write(tmp_path,
+                                      "BENCH_OBS_OVERHEAD_r16.json",
+                                      _obs_doc(2100, full_ns=3100,
+                                               armed_ns=6_000))])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out)
+    assert not report["regressions"]
+    compared = {c["cell"] for c in report["ok"]}
+    assert "unsampled_recorder_armed" not in compared
+    # ... and two armed rounds DO compare: 2x creep on the armed cell
+    # alone gates even inside budget
+    rc = cr.main(["--kind", "obs",
+                  "--previous", _write(tmp_path,
+                                       "BENCH_OBS_OVERHEAD_r16.json",
+                                       _obs_doc(2000, full_ns=3000,
+                                                armed_ns=4_000)),
+                  "--current", _write(tmp_path,
+                                      "BENCH_OBS_OVERHEAD_r17.json",
+                                      _obs_doc(2100, full_ns=3100,
+                                               armed_ns=9_000))])
+    assert rc == 1
+    report = json.loads(capsys.readouterr().out)
+    assert any(c["cell"] == "unsampled_recorder_armed"
+               for c in report["regressions"])
 
 
 def test_check_regression_obs_budget_gates_even_without_prior_round(

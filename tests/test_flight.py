@@ -1,0 +1,380 @@
+"""Unit tests: flight recorder, auto-triage rules, device-time
+accounting (ISSUE 20).
+
+The two production chaos seams documented in docs/RESILIENCE.md land
+here: ``flight-dump-disk-full`` (ENOSPC mid-bundle — the partial temp
+file is discarded, the failure is counted, the process is unaffected)
+and ``flight-trigger-storm`` (duplicate mode doubles a trigger — the
+debounce window must collapse the pair to one bundle).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+
+import pytest
+
+from oryx_tpu.lambda_rt.metrics import MetricsRegistry
+from oryx_tpu.obs.diagnose import (RULES, diagnose, diagnose_bundle,
+                                   merge_surfaces,
+                                   surface_from_bundle)
+from oryx_tpu.obs.device_time import (DeviceTimeAccountant,
+                                      install_process_accountant,
+                                      process_accountant)
+from oryx_tpu.obs.flight import (BUNDLE_FIELDS, RING_EVENT_FIELDS,
+                                 FlightRecorder)
+from oryx_tpu.resilience import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _recorder(tmp_path, registry=None, **kw):
+    clock = kw.pop("clock", None) or FakeClock()
+    kw.setdefault("debounce_sec", 30.0)
+    kw.setdefault("dump_on_exit", False)
+    rec = FlightRecorder("t", registry, dir=str(tmp_path / "flight"),
+                         clock=clock, wall=clock, **kw)
+    return rec, clock
+
+
+def _bundles(tmp_path) -> list[dict]:
+    d = tmp_path / "flight"
+    if not d.is_dir():
+        return []
+    out = []
+    for name in sorted(os.listdir(d)):
+        assert not name.endswith(".tmp"), \
+            f"unpublished temp file leaked: {name}"
+        with open(d / name, encoding="utf-8") as fh:
+            out.append(json.load(fh))
+    return out
+
+
+# -- rings + bundle ----------------------------------------------------------
+
+def test_rings_are_bounded_and_bundle_carries_them(tmp_path):
+    reg = MetricsRegistry()
+    rec, clock = _recorder(tmp_path, reg, ring_events=8, ring_spans=4,
+                           tick_sec=1.0)
+    try:
+        for i in range(20):
+            clock.advance(0.3)
+            rec.observe_request(
+                "GET /r", 200, 1.5, trace_id=f"t{i}",
+                spans=[{"name": "score", "duration_ms": 0.7}])
+        out = rec.trigger("manual")
+        assert out["dumped"] and out["trigger_id"]
+        (bundle,) = _bundles(tmp_path)
+        assert set(bundle) >= set(BUNDLE_FIELDS) - {"diagnosis"}
+        ev = bundle["flight_events"]
+        assert ev["fields"] == list(RING_EVENT_FIELDS)
+        assert len(ev["rows"]) == 8          # bounded, newest kept
+        assert ev["rows"][-1][4] == "t19"
+        assert len(bundle["flight_spans"]["rows"]) == 4
+        # the coarse ticks carried counter deltas from the registry
+        assert bundle["flight_ticks"]
+        assert bundle["counters"].get("flight_dumps", 0) == 0
+    finally:
+        rec.close()
+    assert reg.counters_snapshot()["flight_dumps"] == 1
+
+
+def test_tick_ring_records_counter_deltas_and_gauges(tmp_path):
+    reg = MetricsRegistry()
+    reg.set_gauge("cross_region_staleness_ms", 7.0)
+    rec, clock = _recorder(tmp_path, reg, tick_sec=1.0)
+    try:
+        rec.observe_request("GET /r", 200, 1.0)   # first tick
+        reg.inc("mirror_link_failures", 3)
+        reg.set_gauge("cross_region_staleness_ms", 4200.0)
+        clock.advance(1.5)
+        rec.observe_request("GET /r", 200, 1.0)   # second tick
+        tick = list(rec._ticks_ring)[-1]
+        assert tick["counter_deltas"]["mirror_link_failures"] == 3
+        assert tick["gauges"]["cross_region_staleness_ms"] == 4200.0
+        # the bundle's gauge view IS the newest tick (never a live
+        # gauges_snapshot — see the deadlock note in flight.py)
+        rec.trigger("manual")
+        (bundle,) = _bundles(tmp_path)
+        assert bundle["gauges"] == tick["gauges"]
+    finally:
+        rec.close()
+
+
+# -- triggers: debounce / dedupe / burst / fan-out ---------------------------
+
+def test_debounce_collapses_local_triggers(tmp_path):
+    reg = MetricsRegistry()
+    rec, clock = _recorder(tmp_path, reg, debounce_sec=30.0)
+    try:
+        assert rec.trigger("slo-page")["dumped"]
+        res = rec.trigger("slo-page")
+        assert res == {"dumped": False, "debounced": True,
+                       "debounced_total": 1}
+        assert reg.counters_snapshot()["flight_trigger_debounced"] == 1
+        assert len(_bundles(tmp_path)) == 1
+        # outside the window a fresh local trigger dumps again
+        clock.advance(31.0)
+        assert rec.trigger("slo-page")["dumped"]
+        assert len(_bundles(tmp_path)) == 2
+    finally:
+        rec.close()
+
+
+def test_fanned_in_trigger_bypasses_window_but_dedupes_by_id(tmp_path):
+    rec, _clock = _recorder(tmp_path, debounce_sec=30.0)
+    try:
+        assert rec.trigger("chaos-fault")["dumped"]
+        # a cluster-correlated capture must not be lost to a local
+        # dump moments earlier: the explicit id bypasses the window
+        res = rec.trigger("slo-page", trigger_id="ft-123-1-1")
+        assert res["dumped"] and res["trigger_id"] == "ft-123-1-1"
+        # ... but a same-id replay (scatter retry) is deduped
+        res = rec.trigger("slo-page", trigger_id="ft-123-1-1")
+        assert res == {"dumped": False, "duplicate": True,
+                       "trigger_id": "ft-123-1-1"}
+        assert len(_bundles(tmp_path)) == 2
+    finally:
+        rec.close()
+
+
+def test_error_burst_triggers_a_dump(tmp_path):
+    rec, clock = _recorder(tmp_path, burst_errors=3,
+                           burst_window_sec=10.0)
+    try:
+        for status in (500, 0, 503):
+            clock.advance(0.5)
+            rec.observe_request("GET /r", status, 2.0)
+        (bundle,) = _bundles(tmp_path)
+        assert bundle["trigger_reason"] == "error-burst"
+        # statuses below the 5xx/0 line never count toward a burst
+        clock.advance(60.0)
+        for status in (200, 404, 429):
+            rec.observe_request("GET /r", status, 2.0)
+        assert len(_bundles(tmp_path)) == 1
+    finally:
+        rec.close()
+
+
+def test_chaos_fault_fire_is_a_trigger_and_originator_fans_out(tmp_path):
+    rec, _clock = _recorder(tmp_path)
+    fanned = []
+    rec.fan_out = lambda tid, reason: fanned.append((tid, reason))
+    try:
+        faults.inject("serving-scan-dispatch", mode="error", times=1)
+        with pytest.raises(Exception):
+            faults.fire("serving-scan-dispatch")
+        (bundle,) = _bundles(tmp_path)
+        assert bundle["trigger_reason"] == "chaos-fault"
+        assert bundle["trigger_detail"]["point"] == \
+            "serving-scan-dispatch"
+        # the local (originating) trigger fanned the id cluster-wide
+        assert fanned == [(bundle["trigger_id"], "chaos-fault")]
+        # a fanned-IN trigger (explicit id) must never re-fan
+        res = rec.trigger("chaos-fault", trigger_id="ft-9-9-9")
+        assert res["dumped"] and "fanned_out" not in res
+        assert len(fanned) == 1
+    finally:
+        rec.close()
+
+
+def test_closed_recorder_ignores_fault_fires(tmp_path):
+    rec, _clock = _recorder(tmp_path)
+    rec.close()
+    faults.inject("serving-scan-dispatch", mode="error", times=1)
+    with pytest.raises(Exception):
+        faults.fire("serving-scan-dispatch")
+    assert _bundles(tmp_path) == []
+
+
+# -- the two production chaos seams (docs/RESILIENCE.md rows) ----------------
+
+def test_flight_dump_disk_full_discards_partial_and_counts(tmp_path):
+    reg = MetricsRegistry()
+    rec, clock = _recorder(tmp_path, reg)
+    try:
+        faults.inject("flight-dump-disk-full", mode="error", times=1)
+        res = rec.trigger("slo-page")
+        assert res["dumped"] is False and res["path"] is None
+        # the partial temp file was discarded, never published
+        assert _bundles(tmp_path) == []
+        assert rec.dump_failures == 1
+        assert reg.counters_snapshot()["flight_dump_failures"] == 1
+        # the process is unaffected: the next trigger (outside the
+        # debounce window) publishes normally
+        clock.advance(31.0)
+        assert rec.trigger("slo-page")["dumped"]
+        assert len(_bundles(tmp_path)) == 1
+    finally:
+        rec.close()
+
+
+def test_flight_trigger_storm_collapses_to_one_bundle(tmp_path):
+    reg = MetricsRegistry()
+    rec, _clock = _recorder(tmp_path, reg, debounce_sec=30.0)
+    try:
+        faults.inject("flight-trigger-storm", mode="duplicate",
+                      times=1)
+        res = rec.trigger("slo-page")
+        assert res["dumped"]
+        # duplicate mode doubled the trigger; the debounce window
+        # collapsed the pair to ONE published bundle
+        assert len(_bundles(tmp_path)) == 1
+        assert reg.counters_snapshot()["flight_trigger_debounced"] == 1
+    finally:
+        rec.close()
+
+
+# -- auto-triage rules -------------------------------------------------------
+
+def test_diagnose_empty_surface_is_healthy():
+    out = diagnose({})
+    assert out["healthy"] and out["causes"] == []
+    assert out["rules_evaluated"] == len(RULES)
+
+
+def test_diagnose_mirror_stalled_from_staleness():
+    out = diagnose({"gauges": {"cross_region_staleness_ms": 30000.0},
+                    "counters": {"mirror_link_failures": 4}})
+    top = out["causes"][0]
+    assert top["cause"] == "mirror-stalled"
+    assert top["evidence"]["mirror_link_failures"] == 4
+    assert 0.0 < top["score"] <= 0.95
+
+
+def test_diagnose_ranks_breaker_over_slow_burn_signals():
+    surface = {
+        "gauges": {"cross_region_staleness_ms": 3000.0},
+        "resilience": {"speed-fold": {"name": "speed-fold",
+                                      "state": "open"}},
+        "routes": {"GET /recommend": {"count": 40,
+                                      "server_errors": 2}},
+    }
+    causes = [c["cause"] for c in diagnose(surface)["causes"]]
+    assert causes[0] == "breaker-open"
+    assert set(causes) >= {"breaker-open", "mirror-stalled",
+                           "error-burst"}
+
+
+def test_diagnose_error_burst_needs_material_traffic():
+    quiet = diagnose({"routes": {"GET /r": {"count": 3,
+                                            "server_errors": 3}}})
+    assert not any(c["cause"] == "error-burst"
+                   for c in quiet["causes"])
+    loud = diagnose({"routes": {"GET /r": {"count": 100,
+                                           "server_errors": 30}}})
+    assert loud["causes"][0]["cause"] == "error-burst"
+
+
+def test_diagnose_bundle_reads_the_tick_gauges(tmp_path):
+    bundle = {"counters": {"ingest_sheds": 6}, "gauges": None,
+              "routes": {}, "resilience": None}
+    out = diagnose_bundle(bundle)
+    assert out["causes"][0]["cause"] == "ingest-overload"
+    surface = surface_from_bundle(bundle)
+    assert surface["counters"]["ingest_sheds"] == 6
+    assert surface["gauges"] == {}
+
+
+def test_merge_surfaces_sums_counters_keeps_worst_gauges():
+    merged = merge_surfaces([
+        {"counters": {"ingest_sheds": 2},
+         "gauges": {"device_busy_fraction": 0.2},
+         "routes": {"GET /r": {"count": 10, "server_errors": 1}},
+         "resilience": {"b": {"name": "b", "state": "closed"}}},
+        {"counters": {"ingest_sheds": 3},
+         "gauges": {"device_busy_fraction": 0.9},
+         "routes": {"GET /r": {"count": 5, "server_errors": 4}},
+         "resilience": {"b": {"name": "b", "state": "open"}}},
+    ])
+    assert merged["counters"]["ingest_sheds"] == 5
+    assert merged["gauges"]["device_busy_fraction"] == 0.9
+    assert merged["routes"]["GET /r"]["count"] == 15
+    assert merged["routes"]["GET /r"]["server_errors"] == 5
+    # colliding breaker names keep the open one
+    assert merged["resilience"]["b"]["state"] == "open"
+
+
+def _heading_slug(line: str) -> str:
+    text = line.lstrip("#").strip().lower()
+    text = re.sub(r"[^a-z0-9 _-]", "", text)
+    return text.replace(" ", "-")
+
+
+def test_every_runbook_anchor_resolves_to_a_real_heading():
+    """A runbook link that 404s at 3am is worse than none: every
+    rule's ``docs/FILE.md#anchor`` must name a real doc heading
+    (GitHub slug rules)."""
+    root = os.path.join(os.path.dirname(__file__), os.pardir)
+    slugs_by_doc: dict[str, set] = {}
+    for rule in RULES:
+        doc, _, anchor = rule.runbook.partition("#")
+        assert doc and anchor, f"{rule.name}: malformed runbook " \
+            f"{rule.runbook!r}"
+        if doc not in slugs_by_doc:
+            path = os.path.join(root, doc)
+            with open(path, encoding="utf-8") as fh:
+                slugs_by_doc[doc] = {
+                    _heading_slug(ln) for ln in fh
+                    if ln.startswith("#")}
+        assert anchor in slugs_by_doc[doc], (
+            f"rule {rule.name}: anchor #{anchor} not a heading of "
+            f"{doc}")
+
+
+# -- device-time accounting --------------------------------------------------
+
+def test_device_time_accountant_counters_and_snapshot():
+    reg = MetricsRegistry()
+    clock = FakeClock()
+    acct = DeviceTimeAccountant(reg, clock=clock)
+    acct.note("serve", "ann", 3, 0.004)
+    acct.note("serve", "ann", 3, 0.001)
+    acct.note("measure", None, None, 0.002)
+    counters = reg.counters_snapshot()
+    assert counters["device_time_us"] == 7000
+    assert counters["device_time_us_serve_ann"] == 5000
+    snap = acct.snapshot()
+    assert snap["busy_s"] == pytest.approx(0.007)
+    # busiest-first, with time shares summing to ~1
+    assert snap["by_route"][0]["route_class"] == "serve"
+    assert sum(r["share"] for r in snap["by_route"]) \
+        == pytest.approx(1.0)
+    clock.advance(0.07)
+    assert 0.0 < reg.gauge_value("device_busy_fraction") <= 1.0
+
+
+def test_device_time_accountant_never_raises_on_junk():
+    acct = DeviceTimeAccountant(None)
+    acct.note("serve", object(), "gen?", float("nan"))
+    acct.note("serve", "ok", 1, -5.0)
+    assert acct.snapshot()["busy_s"] >= 0.0
+
+
+def test_process_accountant_hook_roundtrip():
+    prev = process_accountant()
+    acct = DeviceTimeAccountant(None)
+    try:
+        install_process_accountant(acct)
+        assert process_accountant() is acct
+    finally:
+        install_process_accountant(prev)
+    assert process_accountant() is prev

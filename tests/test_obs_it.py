@@ -661,3 +661,197 @@ def test_admin_profile_capture_and_gating(obs_cluster):
         _get(replica.port, "/admin/profile?ms=10")
     assert e.value.code == 503
     th.join(10.0)
+
+
+# -- 7. flight recorder + cluster auto-triage (ISSUE 20) ----------------------
+#
+# A SEPARATE flight-armed cluster on its own broker: the recorder's
+# chaos-fault listener is process-global, so arming the shared
+# obs_cluster fixture would make every other test's injected fault
+# publish bundles.  This cluster opts in via oryx.obs.flight.dir.
+
+def test_flight_endpoints_are_config_gated(obs_cluster):
+    # the shared cluster never set oryx.obs.flight.dir: both the
+    # status view and the manual trigger 404 on every tier
+    for port in (obs_cluster["router"].port,
+                 obs_cluster["replicas"][0].port):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(port, "/admin/flight")
+        assert e.value.code == 404
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(port, "/admin/flight/dump")
+        assert e.value.code == 404
+
+
+@pytest.fixture(scope="module")
+def flight_cluster(tmp_path_factory):
+    """2-shard cluster + router with the flight recorder armed on
+    every tier (no speed layer — the diagnosis path under test is
+    router-joined serving)."""
+    tmp_path = tmp_path_factory.mktemp("flight-it")
+    broker = get_broker("obs-flight-it")
+    _produce_ratings(broker, "OIn")
+    flight_dir = tmp_path / "flight"
+
+    def cfg_fn(extra=None):
+        overlay = {
+            "oryx.obs.flight.dir": str(flight_dir),
+            # short enough that the page trigger lands outside the
+            # window the injected-fault dump opened, long enough that
+            # the fault storm's repeat triggers collapse into it
+            "oryx.obs.flight.debounce-sec": 1.0,
+            # ticks scrape gauge fns, and the SLO engine's burn gauges
+            # evaluate on read — a mid-loop tick could page while the
+            # chaos dump's debounce window is still open and swallow
+            # the transition.  Park the tick clock past the test so
+            # the page fires exactly when the test scrapes /admin/slo.
+            "oryx.obs.flight.tick-sec": 300,
+            "oryx.obs.flight.dump-on-exit": False,
+        }
+        overlay.update(extra or {})
+        return _config(tmp_path, "obs-flight-it", **overlay)
+
+    BatchLayer(cfg_fn()).run_one_generation()
+    replicas = []
+    for s in range(2):
+        layer = ServingLayer(cfg_fn({
+            "oryx.cluster.enabled": True,
+            "oryx.cluster.shard": f"{s}/2",
+        }), port=0)
+        layer.start()
+        replicas.append(layer)
+    router = RouterLayer(cfg_fn(), port=0)
+    router.start()
+    _await(lambda: _router_ready(router), "flight router readiness")
+    yield {"router": router, "replicas": replicas,
+           "flight_dir": flight_dir}
+    router.close()
+    for r in replicas:
+        r.close()
+
+
+def _flight_bundles(flight_dir):
+    """Every published bundle under the per-service subdirs; asserts
+    no temp file ever leaks into the published namespace."""
+    import os
+    bundles = []
+    for root, _dirs, files in os.walk(flight_dir):
+        for f in files:
+            assert not f.endswith(".tmp"), f
+            if f.endswith(".json"):
+                with open(os.path.join(root, f)) as fh:
+                    bundles.append(json.load(fh))
+    return bundles
+
+
+def test_chaos_fault_pages_slo_and_dumps_one_correlated_cluster_bundle(
+        flight_cluster):
+    """ISSUE 20 acceptance: induced chaos fault on a live
+    multi-process cluster -> SLO page -> exactly one cluster-wide
+    flight dump (debounced), bundles correlated by trigger id, and the
+    router-joined /admin/diagnose ranks the injected cause first."""
+    router = flight_cluster["router"]
+    replicas = flight_cluster["replicas"]
+    flight_dir = flight_cluster["flight_dir"]
+    uids = _user_ids(router.port)
+
+    # healthy traffic seeds the availability window and books real
+    # device time on the batcher's scoring dispatches
+    for uid in uids[:3]:
+        assert _get(router.port,
+                    f"/recommend/{uid}?howMany=5")[0] == 200
+    _get(router.port, "/admin/slo")
+    time.sleep(1.1)
+
+    # the induced fault: every scoring drain fails -> all-shard 5xx.
+    # Each consumed fire is itself a flight trigger (chaos-fault) on
+    # all three armed recorders; the storm's repeats collapse into the
+    # debounce window.
+    faults.inject("serving-scan-dispatch", mode="error", times=400)
+    try:
+        failures = 0
+        for uid in (uids * 3)[:8]:
+            try:
+                status = _get(router.port,
+                              f"/recommend/{uid}?howMany=5")[0]
+            except urllib.error.HTTPError as e:
+                status = e.code
+            failures += 1 if status >= 500 else 0
+        assert failures >= 5, failures
+    finally:
+        faults.clear()
+
+    # sit out the debounce window the chaos-fault dump opened, then
+    # drive an evaluation: the availability objective's 5m burn is far
+    # past fast-burn, the transition fires on_page, and the router's
+    # recorder dumps + fans the trigger id to both replicas
+    time.sleep(1.1)
+
+    def _paged():
+        avail = _get(router.port, "/admin/slo")[2][
+            "objectives"]["availability"]
+        return avail["state"] == "page"
+    _await(_paged, "availability page transition", timeout=10.0)
+
+    _, _, fstat = _get(router.port, "/admin/flight")
+    assert fstat["armed"] and fstat["service"] == "router"
+    last = fstat["last_dump"]
+    assert last is not None and last["reason"] == "slo-page", fstat
+    tid = last["trigger_id"]
+    # the fault storm's repeat triggers were debounced, not dumped
+    assert fstat["debounced"] >= 1
+
+    # exactly ONE cluster-wide dump: the originating router bundle
+    # plus one fanned bundle per replica, all sharing the trigger id
+    # (the chaos-fault bundles from the storm carry their own ids)
+    def _correlated():
+        return [b for b in _flight_bundles(flight_dir)
+                if b["trigger_id"] == tid]
+    _await(lambda: len(_correlated()) == 3,
+           "cluster-correlated bundles", timeout=10.0)
+    bundles = _correlated()
+    assert sorted(b["service"] for b in bundles) \
+        == ["router", "serving", "serving"]
+    assert all(b["trigger_reason"] == "slo-page" for b in bundles)
+    by_service = {b["service"]: b for b in bundles}
+    # the router bundle embeds the rule engine's verdict at dump time:
+    # the injected all-shard failure manifests as an error burst
+    diag = by_service["router"]["diagnosis"]
+    assert diag["causes"] \
+        and diag["causes"][0]["cause"] == "error-burst", diag
+    # black-box rings captured the failing requests
+    rows = by_service["router"]["flight_events"]["rows"]
+    route_i = by_service["router"]["flight_events"][
+        "fields"].index("status")
+    assert any(r[route_i] >= 500 for r in rows)
+    # fanned-in replica bundles carry the serving-side forensics
+    assert all(b["device_time"] is not None
+               or b["service"] == "router" for b in bundles)
+
+    # a repeat trigger right after the page dump is debounced — the
+    # "exactly one" guarantee an operator relies on during a storm
+    _, _, repeat = _post(router.port,
+                         "/admin/flight/dump?reason=operator-repeat")
+    assert repeat["dumped"] is False and repeat["debounced"] is True
+
+    # ...but re-POSTing the SAME trigger id is deduped, not re-dumped
+    _, _, dup = _post(
+        router.port, f"/admin/flight/dump?trigger={tid}&reason=slo-page")
+    assert dup["dumped"] is False and dup.get("duplicate") is True
+
+    # router-joined auto-triage ranks the injected cause first, with a
+    # runbook anchor an operator can follow
+    _, _, triage = _get(router.port, "/admin/diagnose?join=1")
+    assert triage["joined_replicas"] == 2
+    assert triage["healthy"] is False
+    top = triage["causes"][0]
+    assert top["cause"] == "error-burst", triage["causes"]
+    assert top["runbook"].startswith("docs/") and "#" in top["runbook"]
+    assert top["evidence"]
+
+    # device-time accounting rode along: the replicas booked the warm
+    # requests' scoring dispatches, visible in the tail taxonomy
+    _, _, tail = _get(replicas[0].port, "/admin/tail")
+    assert tail["device_time"]["busy_s"] > 0.0
+    assert any(r["route_class"] == "serve"
+               for r in tail["device_time"]["by_route"])

@@ -480,8 +480,13 @@ def test_metrics_endpoint(server):
         _get(server, "/recommend/U2?howMany=2")
     _status_of(server, "/recommend/nobody")  # 404 counted as error
     m = _get(server, "/metrics")
+    # device-time accounting is always on: the batcher's execute
+    # brackets feed device_time_us counters + the busy-fraction gauge
     assert set(m) == {"routes", "model_fraction_loaded",
-                      "scoring_batcher", "model_metrics", "resilience"}
+                      "scoring_batcher", "model_metrics", "resilience",
+                      "counters", "freshness", "device_time"}
+    assert m["device_time"]["busy_s"] >= 0.0
+    assert m["freshness"]["device_busy_fraction"] >= 0.0
     # every resilience entry is a named retry/breaker counter dict
     for stats in m["resilience"].values():
         assert stats["kind"] in ("retry", "breaker")

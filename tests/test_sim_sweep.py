@@ -37,7 +37,8 @@ _FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
 # not as a mysterious global slowdown)
 _SWEEP_SEEDS = 200
 _BUDGETS_SEC = {"mirror-partition": 120.0, "reshard-cutover": 90.0,
-                "speed-shard-crash": 60.0, "ingest-overload": 60.0}
+                "speed-shard-crash": 60.0, "ingest-overload": 60.0,
+                "slo-page-flight": 90.0}
 # seeds re-run after each sweep to assert trace-hash reproducibility
 _REPLAY_SAMPLE = (0, 67, 133, 199)
 

@@ -200,6 +200,18 @@ def test_fixture_drift_fires(fixture_findings):
     assert not quiet & {f.symbol for f in fixture_findings}
 
 
+def test_fixture_diagnose_catalog_fires(fixture_findings):
+    assert _have(fixture_findings, "diagnose-catalog",
+                 "uncatalogued-metric", "fixture_renamed_away_counter")
+    assert _have(fixture_findings, "diagnose-catalog",
+                 "uncatalogued-flight-field", "fixture_ghost_field")
+    # negatives: catalogued reads and the documented bundle field
+    quiet = {"fixture_catalogued_counter", "fixture_catalogued_gauge",
+             "trigger_id"}
+    assert not quiet & {f.symbol for f in fixture_findings
+                        if f.pass_name == "diagnose-catalog"}
+
+
 def test_fixture_sim_clock_fires(fixture_findings):
     mine = [f for f in fixture_findings if f.pass_name == "sim-clock"]
     assert _have(fixture_findings, "sim-clock", "direct-time",
